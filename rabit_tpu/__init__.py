@@ -1,0 +1,263 @@
+"""rabit_tpu — TPU-native reliable Allreduce / Broadcast library.
+
+A ground-up rebuild of the capabilities of rabit (DMLC's fault-tolerant
+collective-communication library, reference: /root/reference) designed for
+TPU hardware:
+
+- The data plane executes as XLA programs on a ``jax.sharding.Mesh`` over
+  ICI/DCN (``rabit_tpu.parallel``), instead of the reference's hand-rolled
+  non-blocking TCP tree/ring engine (reference ``src/allreduce_base.cc``).
+- A C++ host-side engine (``native/``) provides the portable CPU fallback,
+  the tracker rendezvous protocol, and the fault-tolerance control plane
+  (the reference's ``AllreduceRobust``, ``src/allreduce_robust.cc``) which
+  must survive accelerator loss.
+- This Python module mirrors the reference binding ``python/rabit.py`` API
+  (init/finalize/allreduce/broadcast/checkpoint, reference rabit.py:88-364)
+  while adding a native-JAX convenience layer for device-resident arrays.
+
+Public API parity map (reference file:line):
+    init/finalize            rabit.py:88-120,  include/rabit/rabit.h:94-99
+    get_rank/get_world_size  rabit.py:122-140, rabit.h:102-107
+    is_distributed           rabit.h:108-109
+    get_processor_name       rabit.py:152-169, rabit.h:110-112
+    tracker_print            rabit.py:142-150, rabit.h:119-130
+    broadcast                rabit.py:171-206, rabit.h:142-175
+    allreduce                rabit.py:209-263, rabit.h:200-242
+    load_checkpoint          rabit.py:266-316, rabit.h:267-287
+    checkpoint               rabit.py:318-351, rabit.h:288-305
+    version_number           rabit.py:353-364, rabit.h:306-312
+"""
+
+from __future__ import annotations
+
+import atexit
+import pickle
+import sys
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .ops.reducers import (
+    MAX, MIN, SUM, BITOR, OP_NAMES, DTYPE_ENUM, is_valid_op_dtype)
+from .engine.base import Engine
+from .utils.config import Config
+
+__version__ = "0.1.0"
+
+_engine: Optional[Engine] = None
+
+
+def _require_engine() -> Engine:
+    global _engine
+    if _engine is None:
+        raise RuntimeError(
+            "rabit_tpu is not initialized; call rabit_tpu.init() first")
+    return _engine
+
+
+def init(args: Optional[list] = None, engine: str = "auto", **kwargs) -> None:
+    """Initialize the library. Call once before anything else.
+
+    Mirrors rabit.init (reference rabit.py:88-113) / rabit::Init
+    (rabit.h:94-96).
+
+    Parameters
+    ----------
+    args: list of str, optional
+        ``key=value`` configuration strings (the reference feeds argv the
+        same way, allreduce_base.cc:56-68). Defaults to ``sys.argv[1:]``.
+    engine: str
+        Which engine backend to use:
+          - ``"auto"``: native socket engine when a tracker is configured
+            (``RABIT_TRACKER_URI``/``DMLC_TRACKER_URI`` env), else the
+            single-process empty engine.
+          - ``"empty"``: single-process no-op engine (reference
+            src/engine_empty.cc).
+          - ``"native"``: C++ socket tree/ring engine (reference
+            src/allreduce_base.cc) — no fault tolerance.
+          - ``"robust"``: C++ fault-tolerant engine (reference
+            src/allreduce_robust.cc).
+          - ``"mock"``: robust engine + scripted fault injection (reference
+            src/allreduce_mock.h).
+          - ``"xla"``: JAX/XLA collectives over the device mesh (TPU-native
+            data plane; no reference equivalent — this is the point).
+    """
+    global _engine
+    if _engine is not None:
+        import warnings
+        warnings.warn("rabit_tpu.init called twice; ignored", stacklevel=2)
+        return
+    if args is None:
+        args = [a for a in sys.argv[1:] if "=" in a]
+    args = [a.decode() if isinstance(a, bytes) else str(a) for a in args]
+    cfg = Config.from_args(args, **kwargs)
+
+    if engine == "auto":
+        if cfg.get("rabit_tracker_uri") or cfg.get("dmlc_tracker_uri"):
+            engine = cfg.get("rabit_engine", "robust")
+        else:
+            engine = cfg.get("rabit_engine", "empty")
+
+    try:
+        if engine == "empty":
+            from .engine.empty import EmptyEngine
+            _engine = EmptyEngine()
+        elif engine == "xla":
+            from .engine.xla import XlaEngine
+            _engine = XlaEngine()
+        elif engine in ("native", "base", "robust", "mock"):
+            from .engine.native import NativeEngine
+            _engine = NativeEngine(variant=engine)
+        else:
+            raise ValueError(f"unknown engine {engine!r}")
+    except ImportError as e:
+        raise RuntimeError(
+            f"engine {engine!r} is not available in this build: {e}") from e
+    _engine.init(args)
+
+
+def finalize() -> None:
+    """Shut the engine down. Mirrors rabit.finalize (rabit.py:115-120)."""
+    global _engine
+    if _engine is not None:
+        _engine.shutdown()
+        _engine = None
+
+
+@atexit.register
+def _atexit_finalize() -> None:  # pragma: no cover - best-effort cleanup
+    global _engine
+    if _engine is not None:
+        try:
+            _engine.shutdown()
+        except Exception:
+            pass
+        _engine = None
+
+
+def get_rank() -> int:
+    """Rank of this worker (rabit.py:122-130, rabit.h:102-103)."""
+    return _require_engine().rank
+
+
+def get_world_size() -> int:
+    """Total number of workers (rabit.py:132-140, rabit.h:106-107)."""
+    return _require_engine().world_size
+
+
+def is_distributed() -> bool:
+    """Whether running in distributed mode (rabit.h:108-109)."""
+    return _require_engine().is_distributed
+
+
+def get_processor_name() -> str:
+    """Host identifier of this worker (rabit.py:152-169)."""
+    return _require_engine().host
+
+
+def tracker_print(msg: str) -> None:
+    """Print a message via the tracker from rank 0's perspective
+    (rabit.py:142-150; reference routes this over the tracker socket,
+    allreduce_base.cc:145-153)."""
+    _require_engine().tracker_print(str(msg))
+
+
+def allreduce(data: np.ndarray, op: int,
+              prepare_fun: Optional[Callable[[np.ndarray], None]] = None,
+              ) -> np.ndarray:
+    """Allreduce a numpy array across all workers; returns the result.
+
+    Mirrors rabit.allreduce (rabit.py:229-263): the input is flattened,
+    reduced elementwise with ``op`` across ranks, and returned with the
+    input's shape. ``prepare_fun`` is the lazy initializer (rabit.h:222-231):
+    it is invoked on ``data`` right before the reduction actually runs, and
+    is skipped entirely when the engine can replay a cached result during
+    failure recovery.
+    """
+    if not isinstance(data, np.ndarray):
+        raise TypeError("allreduce only takes numpy.ndarray")
+    if np.dtype(data.dtype) not in DTYPE_ENUM:
+        raise TypeError(f"dtype {data.dtype} not supported")
+    if op not in OP_NAMES:
+        raise ValueError(f"unknown op {op}")
+    if not is_valid_op_dtype(op, data.dtype):
+        raise TypeError(
+            f"op {OP_NAMES[op]} is not defined for dtype {data.dtype} "
+            "(reference rejects BitOR on floats, c_api.cc:26-35)")
+    eng = _require_engine()
+    shape = data.shape
+    buf = data.flatten()  # always a contiguous 1-D copy, never aliases data
+    if prepare_fun is None:
+        pf = None
+    else:
+        def pf(b=buf, d=data, f=prepare_fun):
+            f(d)
+            b[:] = np.ascontiguousarray(d).reshape(-1)
+    eng.allreduce(buf, op, prepare_fun=pf)
+    return buf.reshape(shape)
+
+
+def broadcast(data: Any, root: int) -> Any:
+    """Broadcast a picklable object from ``root`` to every worker
+    (rabit.py:171-206: two-phase length-then-payload broadcast)."""
+    eng = _require_engine()
+    rank = eng.rank
+    if not 0 <= root < eng.world_size:
+        raise ValueError(
+            f"broadcast root {root} out of range for world_size "
+            f"{eng.world_size}")
+    payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL) \
+        if rank == root else None
+    out = eng.broadcast(payload, root)
+    return data if rank == root else pickle.loads(out)
+
+
+def load_checkpoint(with_local: bool = False):
+    """Load the latest checkpoint (rabit.py:283-316, rabit.h:267-287).
+
+    Returns ``(version, global_model)`` or
+    ``(version, global_model, local_model)``; version 0 means nothing was
+    checkpointed yet and the caller must initialize its own model.
+    """
+    eng = _require_engine()
+    version, gbytes, lbytes = eng.load_checkpoint(with_local)
+    gmodel = pickle.loads(gbytes) if version > 0 and gbytes else None
+    if with_local:
+        lmodel = pickle.loads(lbytes) if version > 0 and lbytes else None
+        return (version, gmodel, lmodel)
+    return (version, gmodel)
+
+
+def checkpoint(global_model: Any, local_model: Any = None) -> None:
+    """Checkpoint the model; bumps the version number by one
+    (rabit.py:318-351, rabit.h:288-300). ``global_model`` must be identical
+    on all ranks; ``local_model`` is per-rank and ring-replicated by the
+    robust engine (reference allreduce_robust.cc:1363-1399)."""
+    eng = _require_engine()
+    gbytes = pickle.dumps(global_model, protocol=pickle.HIGHEST_PROTOCOL)
+    lbytes = None if local_model is None else pickle.dumps(
+        local_model, protocol=pickle.HIGHEST_PROTOCOL)
+    eng.checkpoint(gbytes, lbytes)
+
+
+def lazy_checkpoint(global_model: Any) -> None:
+    """Lazy checkpoint: defers serialization until a failure actually
+    requires it (rabit.h:301-305; reference stores a pointer,
+    allreduce_robust.cc:957-964). The Python layer snapshots at failure
+    time via the engine's lazy hook."""
+    eng = _require_engine()
+    eng.lazy_checkpoint(
+        lambda m=global_model: pickle.dumps(m, protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def version_number() -> int:
+    """Number of CheckPoint calls so far (rabit.py:353-364)."""
+    return _require_engine().version_number
+
+
+__all__ = [
+    "init", "finalize", "get_rank", "get_world_size", "is_distributed",
+    "get_processor_name", "tracker_print", "allreduce", "broadcast",
+    "load_checkpoint", "checkpoint", "lazy_checkpoint", "version_number",
+    "MAX", "MIN", "SUM", "BITOR",
+]

@@ -1,0 +1,5 @@
+"""Engine layer — pluggable collective backends (reference L3/L2:
+include/rabit/internal/engine.h IEngine + the five interchangeable
+engines in src/)."""
+
+from .base import Engine  # noqa: F401

@@ -1,0 +1,95 @@
+"""Abstract engine interface — the Python face of the reference's
+``IEngine`` (engine.h:32-183). One engine instance per process; the
+reference keeps a thread-local singleton (engine.cc:33-43), which in
+Python is the module-global in ``rabit_tpu.__init__`` (the API is
+documented not thread-safe, rabit.h:177-178)."""
+
+from __future__ import annotations
+
+import socket
+from abc import ABC, abstractmethod
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+class Engine(ABC):
+    """Collective engine. Buffers are 1-D contiguous numpy arrays mutated
+    in place, matching the reference's in-place sendrecvbuf contract
+    (engine.h:74-96)."""
+
+    @abstractmethod
+    def init(self, args: List[str]) -> None:
+        """Bootstrap: parse config, rendezvous, establish links
+        (IEngine construction + AllreduceBase::Init,
+        allreduce_base.cc:53-120)."""
+
+    @abstractmethod
+    def shutdown(self) -> None:
+        """Tear down links / notify tracker (AllreduceBase::Shutdown,
+        allreduce_base.cc:125-142)."""
+
+    # -- collectives ------------------------------------------------------
+    @abstractmethod
+    def allreduce(self, buf: np.ndarray, op: int,
+                  prepare_fun: Optional[Callable[[], None]] = None,
+                  key: str = "") -> None:
+        """In-place elementwise allreduce of ``buf`` across ranks
+        (IEngine::Allreduce, engine.h:74-96). ``prepare_fun`` runs lazily
+        right before the reduction and is skipped when the result is
+        replayed from the recovery cache. ``key`` is the caller-signature
+        cache key used by the bootstrap cache (rabit.h:26-39)."""
+
+    @abstractmethod
+    def broadcast(self, data: Optional[bytes], root: int) -> bytes:
+        """Broadcast a byte string from ``root``; returns the payload on
+        every rank (IEngine::Broadcast, engine.h:98-105). Non-root ranks
+        pass ``None``. Handles the size pre-broadcast internally
+        (rabit-inl.h:130-165)."""
+
+    # -- checkpointing ----------------------------------------------------
+    def load_checkpoint(self, with_local: bool = False
+                        ) -> Tuple[int, Optional[bytes], Optional[bytes]]:
+        """Returns (version, global_bytes, local_bytes); version 0 means
+        fresh start (IEngine::LoadCheckPoint, engine.h:107-137)."""
+        return (0, None, None)
+
+    def checkpoint(self, global_bytes: bytes,
+                   local_bytes: Optional[bytes] = None) -> None:
+        """Two-phase commit checkpoint; bumps version
+        (IEngine::CheckPoint, engine.h:139-153)."""
+        self._version += 1
+
+    def lazy_checkpoint(self, make_global: Callable[[], bytes]) -> None:
+        """Defer serialization until a failure needs it
+        (IEngine::LazyCheckPoint, engine.h:155-166)."""
+        self._version += 1
+
+    # -- properties -------------------------------------------------------
+    _version: int = 0
+
+    @property
+    def version_number(self) -> int:
+        return self._version
+
+    @property
+    @abstractmethod
+    def rank(self) -> int: ...
+
+    @property
+    @abstractmethod
+    def world_size(self) -> int: ...
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.world_size > 1
+
+    @property
+    def host(self) -> str:
+        return socket.gethostname()
+
+    def tracker_print(self, msg: str) -> None:
+        """Default: rank-0 stdout, like the empty/MPI engines
+        (engine_empty.cc TrackerPrint)."""
+        if self.rank == 0:
+            print(msg, flush=True)

@@ -1,0 +1,6 @@
+"""Reduction operators and dtype tables (reference rabit-inl.h:21-102)."""
+
+from .reducers import (  # noqa: F401
+    MAX, MIN, SUM, BITOR, OP_NAMES, DTYPE_ENUM, ENUM_DTYPE,
+    numpy_reduce, jax_reduce_fn, is_valid_op_dtype,
+)

@@ -1,0 +1,92 @@
+"""Reduction operators and dtype enumeration.
+
+Reference parity:
+- op functors ``op::Max/Min/Sum/BitOR`` (rabit-inl.h:66-102; enum order
+  kMax=0,kMin=1,kSum=2,kBitwiseOR=3 per engine.h:195-200).
+- dtype enum table (rabit.py:209-218 for the Python 8; the C ABI supports
+  char..double via mpi::GetType<T>, rabit-inl.h:21-62).
+
+The TPU design keeps the same numeric wire enums (they cross the C ABI),
+but the reduction itself is expressed three ways:
+  * numpy (host fallback / empty engine / verification),
+  * a jax-traceable lambda (used inside jitted mesh collectives),
+  * natively in C++ for the socket engine (native/src/reducer.h).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+# Op enums — wire-compatible with the reference (engine.h:195-200).
+MAX = 0
+MIN = 1
+SUM = 2
+BITOR = 3
+
+OP_NAMES = {MAX: "max", MIN: "min", SUM: "sum", BITOR: "bitor"}
+
+# Dtype enums — wire-compatible with the reference C ABI dispatch
+# (c_api.cc:37-122) / python table (rabit.py:209-218).
+DTYPE_ENUM = {
+    np.dtype("int8"): 0,
+    np.dtype("uint8"): 1,
+    np.dtype("int32"): 2,
+    np.dtype("uint32"): 3,
+    np.dtype("int64"): 4,
+    np.dtype("uint64"): 5,
+    np.dtype("float32"): 6,
+    np.dtype("float64"): 7,
+    # TPU-native extensions (no reference equivalent): bf16 + f16 so the
+    # hot path can stay in the MXU/VPU-preferred formats.
+    np.dtype("float16"): 8,
+}
+ENUM_DTYPE = {v: k for k, v in DTYPE_ENUM.items()}
+
+try:  # bfloat16 exists when ml_dtypes/jax is importable (always, here)
+    import ml_dtypes
+    DTYPE_ENUM[np.dtype(ml_dtypes.bfloat16)] = 9
+    ENUM_DTYPE[9] = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    pass
+
+_FLOAT_ENUMS = frozenset(e for d, e in DTYPE_ENUM.items() if d.kind == "f"
+                         or d.name == "bfloat16")
+
+
+def is_valid_op_dtype(op: int, dtype: np.dtype) -> bool:
+    """BitOR on floating types is rejected, like the reference C ABI's
+    FHelper specialization (c_api.cc:26-35)."""
+    if op == BITOR and DTYPE_ENUM[np.dtype(dtype)] in _FLOAT_ENUMS:
+        return False
+    return True
+
+
+def numpy_reduce(dst: np.ndarray, src: np.ndarray, op: int) -> None:
+    """In-place elementwise ``dst = op(dst, src)`` — host-side equivalent of
+    op::Reducer (rabit-inl.h:95-102)."""
+    if op == SUM:
+        np.add(dst, src, out=dst)
+    elif op == MAX:
+        np.maximum(dst, src, out=dst)
+    elif op == MIN:
+        np.minimum(dst, src, out=dst)
+    elif op == BITOR:
+        np.bitwise_or(dst, src, out=dst)
+    else:
+        raise ValueError(f"unknown op {op}")
+
+
+def jax_reduce_fn(op: int) -> Callable:
+    """Binary jax-traceable combiner for use inside jitted collectives."""
+    import jax.numpy as jnp
+    if op == SUM:
+        return jnp.add
+    if op == MAX:
+        return jnp.maximum
+    if op == MIN:
+        return jnp.minimum
+    if op == BITOR:
+        return jnp.bitwise_or
+    raise ValueError(f"unknown op {op}")

@@ -1,0 +1,4 @@
+"""Host-side utilities: config parsing, logging, timers, serialization."""
+
+from .config import Config, parse_size  # noqa: F401
+from .log import log_info, check, CheckError  # noqa: F401

@@ -1,0 +1,29 @@
+"""Logging + check helpers, mirroring the reference's utils.h semantics:
+Assert/Check/Error either kill the process or raise, controlled by
+``DMLC_WORKER_STOP_PROCESS_ON_ERROR`` (utils.h:65-95,
+allreduce_base.cc:202-210). The Python layer always raises — process-exit
+is only meaningful inside the C++ engine, which honours the same flag."""
+
+from __future__ import annotations
+
+import sys
+import time
+
+
+class CheckError(RuntimeError):
+    """Raised when a rabit_tpu invariant check fails (utils::Check)."""
+
+
+def check(cond: bool, msg: str = "") -> None:
+    if not cond:
+        raise CheckError(f"check failed: {msg}")
+
+
+_START = time.monotonic()
+
+
+def log_info(fmt: str, *args) -> None:
+    """Timestamped info log (utils::HandleLogInfo, utils.h:100-108)."""
+    msg = fmt % args if args else fmt
+    print(f"[rabit_tpu {time.monotonic() - _START:9.3f}s] {msg}",
+          file=sys.stderr, flush=True)

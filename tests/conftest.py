@@ -1,0 +1,26 @@
+"""Test harness config: force an 8-device virtual CPU mesh so multi-chip
+sharding paths compile and run without TPU hardware (the driver's
+dryrun_multichip uses the same mechanism). Must run before jax imports."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+import rabit_tpu  # noqa: E402
+
+
+@pytest.fixture
+def single_engine():
+    """A fresh single-process engine for each test."""
+    rabit_tpu.finalize()
+    rabit_tpu.init([], engine="empty")
+    yield
+    rabit_tpu.finalize()

@@ -1,0 +1,93 @@
+"""Single-process API semantics (reference: engine_empty.cc behavior +
+rabit.py binding contract)."""
+
+import numpy as np
+import pytest
+
+import rabit_tpu
+
+
+def test_rank_world(single_engine):
+    assert rabit_tpu.get_rank() == 0
+    assert rabit_tpu.get_world_size() == 1
+    assert not rabit_tpu.is_distributed()
+    assert isinstance(rabit_tpu.get_processor_name(), str)
+
+
+def test_allreduce_identity(single_engine):
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    out = rabit_tpu.allreduce(x, rabit_tpu.SUM)
+    np.testing.assert_array_equal(out, x)
+    assert out.shape == x.shape
+    # input must not be aliased by the output (rabit.py:246-248 copies)
+    out[0, 0] = 99
+    assert x[0, 0] == 0
+
+
+def test_allreduce_prepare_fun_runs(single_engine):
+    # EmptyEngine still runs prepare_fun (engine_empty.cc:57-62)
+    x = np.zeros(4, dtype=np.float64)
+    called = []
+
+    def prep(d):
+        called.append(True)
+        d[:] = 7.0
+
+    out = rabit_tpu.allreduce(x, rabit_tpu.MAX, prepare_fun=prep)
+    assert called
+    np.testing.assert_array_equal(out, np.full(4, 7.0))
+
+
+def test_allreduce_rejects_bad_input(single_engine):
+    with pytest.raises(TypeError):
+        rabit_tpu.allreduce([1, 2, 3], rabit_tpu.SUM)
+    with pytest.raises(ValueError):
+        rabit_tpu.allreduce(np.zeros(3, np.float32), 42)
+    # BitOR on floats rejected at the API boundary (c_api.cc:26-35)
+    with pytest.raises(TypeError):
+        rabit_tpu.allreduce(np.zeros(3, np.float32), rabit_tpu.BITOR)
+
+
+def test_broadcast_root_range(single_engine):
+    with pytest.raises(ValueError):
+        rabit_tpu.broadcast({"x": 1}, root=1)
+    with pytest.raises(ValueError):
+        rabit_tpu.broadcast({"x": 1}, root=-1)
+
+
+def test_unavailable_engine_message():
+    rabit_tpu.finalize()
+    with pytest.raises((RuntimeError, ValueError)):
+        rabit_tpu.init([], engine="no_such_engine")
+
+
+def test_broadcast_object(single_engine):
+    obj = {"s": "hello", "v": [1, 2, 3]}
+    assert rabit_tpu.broadcast(obj, 0) == obj
+
+
+def test_checkpoint_roundtrip(single_engine):
+    version, model = rabit_tpu.load_checkpoint()
+    assert version == 0 and model is None
+    rabit_tpu.checkpoint({"w": [1.0, 2.0]})
+    assert rabit_tpu.version_number() == 1
+    version, model = rabit_tpu.load_checkpoint()
+    assert version == 1
+    assert model == {"w": [1.0, 2.0]}
+    rabit_tpu.checkpoint({"w": [3.0]}, local_model={"r": 0})
+    version, gmodel, lmodel = rabit_tpu.load_checkpoint(with_local=True)
+    assert version == 2
+    assert gmodel == {"w": [3.0]}
+    assert lmodel == {"r": 0}
+
+
+def test_lazy_checkpoint(single_engine):
+    rabit_tpu.lazy_checkpoint({"m": 1})
+    assert rabit_tpu.version_number() == 1
+    version, model = rabit_tpu.load_checkpoint()
+    assert version == 1 and model == {"m": 1}
+
+
+def test_double_init_warns(single_engine):
+    with pytest.warns(UserWarning):
+        rabit_tpu.init([], engine="empty")

@@ -1,0 +1,54 @@
+"""Parameter-system tests, mirroring the reference's unit coverage
+(test/cpp/allreduce_base_test.cpp:9-66: task_id, bootstrap cache flag,
+debug flag, ring mincount)."""
+
+import numpy as np
+import pytest
+
+from rabit_tpu.utils.config import Config, parse_size
+
+
+def test_argv_overrides_env(monkeypatch):
+    monkeypatch.setenv("RABIT_TASK_ID", "env_task")
+    cfg = Config.from_args(["rabit_task_id=argv_task"])
+    assert cfg.get("rabit_task_id") == "argv_task"
+
+
+def test_dmlc_alias(monkeypatch):
+    monkeypatch.setenv("DMLC_TRACKER_URI", "1.2.3.4")
+    cfg = Config.from_args([])
+    assert cfg.get("rabit_tracker_uri") == "1.2.3.4"
+
+
+def test_ring_mincount_param():
+    cfg = Config.from_args(["rabit_reduce_ring_mincount=10"])
+    assert cfg.get_int("rabit_reduce_ring_mincount") == 10
+
+
+def test_bootstrap_cache_and_debug_flags():
+    cfg = Config.from_args(["rabit_bootstrap_cache=1", "rabit_debug=true"])
+    assert cfg.get_bool("rabit_bootstrap_cache")
+    assert cfg.get_bool("rabit_debug")
+    assert not cfg.get_bool("rabit_missing_flag")
+
+
+def test_parse_size_suffixes():
+    # ParseUnit semantics (allreduce_base.cc:156-176); default buffer 256MB
+    assert parse_size("256MB") == 256 << 20
+    assert parse_size("1G") == 1 << 30
+    assert parse_size("32K") == 32 << 10
+    assert parse_size("1024") == 1024
+    assert parse_size("512B") == 512
+
+
+def test_repeatable_mock_keys():
+    # repeated mock=r,v,s,n argv params accumulate (allreduce_mock.h:38-44)
+    cfg = Config.from_args(["mock=0,0,0,0", "mock=1,1,1,0"])
+    assert cfg.get_all("mock") == ["0,0,0,0", "1,1,1,0"]
+    cfg.append("rabit_mock", "2,2,2,0")
+    assert cfg.get_all("rabit_mock") == ["2,2,2,0"]
+
+
+def test_bad_size_raises():
+    with pytest.raises(ValueError):
+        parse_size("12Q")

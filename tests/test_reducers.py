@@ -1,0 +1,55 @@
+"""Reduction-operator semantics (rabit-inl.h:66-102) across the numpy and
+jax paths."""
+
+import numpy as np
+import pytest
+
+from rabit_tpu.ops import reducers as R
+
+
+@pytest.mark.parametrize("op,expect", [
+    (R.SUM, [5, 7, 9]),
+    (R.MAX, [4, 5, 6]),
+    (R.MIN, [1, 2, 3]),
+])
+def test_numpy_reduce_arith(op, expect):
+    dst = np.array([1, 2, 3], dtype=np.int64)
+    src = np.array([4, 5, 6], dtype=np.int64)
+    R.numpy_reduce(dst, src, op)
+    np.testing.assert_array_equal(dst, expect)
+
+
+def test_numpy_reduce_bitor():
+    dst = np.array([0b0011, 0b0101], dtype=np.uint32)
+    src = np.array([0b0110, 0b1000], dtype=np.uint32)
+    R.numpy_reduce(dst, src, R.BITOR)
+    np.testing.assert_array_equal(dst, [0b0111, 0b1101])
+
+
+def test_bitor_float_rejected():
+    # FHelper rejection of BitOR on floats (c_api.cc:26-35)
+    assert not R.is_valid_op_dtype(R.BITOR, np.float32)
+    assert not R.is_valid_op_dtype(R.BITOR, np.float64)
+    assert R.is_valid_op_dtype(R.BITOR, np.uint32)
+    assert R.is_valid_op_dtype(R.SUM, np.float32)
+
+
+def test_dtype_enum_wire_values():
+    # wire-compatibility with reference rabit.py:209-218
+    assert R.DTYPE_ENUM[np.dtype("int8")] == 0
+    assert R.DTYPE_ENUM[np.dtype("uint8")] == 1
+    assert R.DTYPE_ENUM[np.dtype("int32")] == 2
+    assert R.DTYPE_ENUM[np.dtype("uint32")] == 3
+    assert R.DTYPE_ENUM[np.dtype("int64")] == 4
+    assert R.DTYPE_ENUM[np.dtype("uint64")] == 5
+    assert R.DTYPE_ENUM[np.dtype("float32")] == 6
+    assert R.DTYPE_ENUM[np.dtype("float64")] == 7
+
+
+def test_jax_reduce_fn():
+    import jax.numpy as jnp
+    a = jnp.array([1.0, 5.0])
+    b = jnp.array([4.0, 2.0])
+    assert R.jax_reduce_fn(R.SUM)(a, b).tolist() == [5.0, 7.0]
+    assert R.jax_reduce_fn(R.MAX)(a, b).tolist() == [4.0, 5.0]
+    assert R.jax_reduce_fn(R.MIN)(a, b).tolist() == [1.0, 2.0]
