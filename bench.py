@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""Benchmark: XGBoost-style gradient-histogram allreduce on TPU.
+
+The north-star workload (BASELINE.json): each worker builds a per-bin
+(grad, hess) histogram from its rows and allreduces it across the mesh.
+The reference library does this on host CPUs feeding a socket
+tree/ring (test/speed_test.cc measures the collective alone); our
+TPU-native path does bucketize+accumulate on the MXU and reduces over
+ICI in the same XLA program.
+
+Headline metric: gradient-pair GB/s processed end-to-end (device-resident
+inputs -> replicated histogram), vs the host-CPU numpy baseline doing the
+same local histogram (the compute the reference would feed its
+allreduce).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 1)[0])
+
+
+WARMUP = 2
+ITERS = 6
+
+
+def _bench(fn, combine):
+    """Pipelined throughput: chain ITERS executions on distinct datasets
+    with a single device->host fetch at the end, measured wall-clock /
+    ITERS. Measurement notes for this tunnelled-TPU environment:
+    - the runtime memoizes (executable, inputs) -> result, so every
+      call uses a dataset the executable has never seen;
+    - jax.block_until_ready does NOT reliably wait here; only a host
+      fetch (np.asarray) synchronizes — hence the combine+fetch tail;
+    - a single dispatch+fetch costs ~70-80 ms regardless of payload, so
+      per-call timing measures the tunnel, not the device; chaining
+      amortizes it."""
+    import numpy as np
+    np.asarray(fn(0))  # compile + first-touch
+    t0 = time.perf_counter()
+    outs = [fn(1 + i) for i in range(ITERS)]
+    np.asarray(combine(outs))
+    return (time.perf_counter() - t0) / ITERS
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from rabit_tpu.parallel import make_mesh
+    from rabit_tpu.models import histogram as H
+    from rabit_tpu.parallel.collectives import shard_over
+
+    p = len(jax.devices())
+    n = 1 << 21          # rows per worker
+    nbins = 1024         # flattened (feature, bucket) ids
+    # one distinct dataset per (warmup+timed) call, so the tunnel's
+    # (executable, inputs) result memo never hits
+    nsets = 1 + ITERS
+    mesh = make_mesh(p)
+
+    host_sets = [H.make_inputs(n, nbins, p=p, seed=1000 + s)
+                 for s in range(nsets)]
+    # pre-stage everything so H2D never lands inside the timed region
+    dev_sets = [tuple(shard_over(mesh, a) for a in st) for st in host_sets]
+    jax.block_until_ready(dev_sets)
+    grad, hess, bins = host_sets[0]
+
+    def run(method, i=0):
+        g, h, b = dev_sets[i % nsets]
+        return H.distributed_histogram(g, h, b, nbins, mesh, "workers",
+                                       method)
+
+    import jax.numpy as jnp
+
+    methods = ("pallas", "scatter") if jax.default_backend() == "tpu" \
+        else ("matmul", "scatter")
+    results = {}
+    for method in methods:
+        try:
+            results[method] = _bench(
+                lambda i, m=method: run(m, i),
+                lambda outs: jnp.stack(outs).sum(0))
+        except Exception as e:  # pragma: no cover
+            print(f"# {method} failed: {e}", file=sys.stderr)
+    if not results:
+        raise RuntimeError(
+            f"all benchmark methods {methods} failed; see stderr above")
+    best_method = min(results, key=results.get)
+    t_dev = results[best_method]
+
+    nbytes = p * n * 12  # grad f32 + hess f32 + bins i32 per row
+    dev_gbps = nbytes / t_dev / 1e9
+
+    # Host baseline: numpy histogram on one worker's rows, scaled to p
+    # workers running serially on one host core-set (what the reference's
+    # worker would do before its socket allreduce).
+    t0 = time.perf_counter()
+    H.host_histogram(grad[0], hess[0], bins[0], nbins)
+    t_host = (time.perf_counter() - t0) * p
+    host_gbps = nbytes / t_host / 1e9
+
+    # correctness spot check
+    got = np.asarray(run(best_method))
+    want = np.zeros((nbins, 2), np.float64)
+    for i in range(p):
+        want += H.host_histogram(grad[i], hess[i], bins[i], nbins)
+    ok = np.allclose(got, want, rtol=2e-2, atol=2e-2)
+
+    print(f"# devices={p} n/worker={n} nbins={nbins} "
+          f"method={best_method} t_dev={t_dev*1e3:.2f}ms "
+          f"t_host={t_host*1e3:.2f}ms correct={ok}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "histogram_allreduce_throughput",
+        "value": round(dev_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(dev_gbps / host_gbps, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

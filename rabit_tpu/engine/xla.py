@@ -1,0 +1,187 @@
+"""XLA engine — the TPU-native data plane behind the rabit host API.
+
+Maps the reference's process-centric model onto JAX multi-process SPMD:
+rank ↔ ``jax.process_index()`` and world ↔ ``jax.process_count()`` (the
+tracker's rendezvous role is played by the JAX coordination service,
+``jax.distributed.initialize`` — SURVEY §2.3). Each rank's host buffer is
+staged onto its local device as one slice of a global ``[world, n]``
+array sharded over a one-device-per-process mesh; the reduction runs as a
+jitted XLA program whose cross-process collective rides ICI/DCN; the
+replicated result is fetched back into the caller's buffer — preserving
+the reference's in-place ``sendrecvbuf`` contract (engine.h:74-96).
+
+Ring-vs-tree dispatch by element count implements the
+``reduce_ring_mincount`` crossover (allreduce_base.h:532-534) the
+reference documents but never wires.
+
+Fault tolerance note: this engine is the *data plane* only. XLA
+collectives hang if a participant dies (SURVEY §7 hard parts); the robust
+control plane (consensus, replay, recovery) lives host-side in the C++
+engine and wraps this one.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .base import Engine
+from ..utils.config import Config
+from ..utils.log import log_info
+
+
+class XlaEngine(Engine):
+    def __init__(self) -> None:
+        self._rank = 0
+        self._world = 1
+        self._mesh = None
+        self._cfg: Optional[Config] = None
+        self._global: Optional[bytes] = None
+        self._local: Optional[bytes] = None
+        self._lazy: Optional[Callable[[], bytes]] = None
+        self._version = 0
+        self._ring_mincount = 32 << 10
+        self._debug = False
+
+    def init(self, args: List[str]) -> None:
+        import jax
+        cfg = Config.from_args(args)
+        self._cfg = cfg
+        coord = cfg.get("rabit_coordinator")
+        nproc = cfg.get_int("rabit_num_processes", 0)
+        if coord and nproc > 1:
+            # Multi-host bootstrap: the JAX coordination service is the
+            # tracker (reference ConnectTracker, allreduce_base.cc:222-259).
+            # Must run before anything touches the XLA backend, so the
+            # already-initialized check inspects distributed state only.
+            from jax._src.distributed import global_state
+            if global_state.client is None:
+                jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=nproc,
+                    process_id=cfg.get_int("rabit_process_id", 0))
+        self._rank = jax.process_index()
+        self._world = jax.process_count()
+        self._ring_mincount = cfg.get_int(
+            "rabit_reduce_ring_mincount", 32 << 10)
+        self._debug = cfg.get_bool("rabit_debug")
+        if self._world > 1:
+            self._mesh = self._build_mesh()
+
+    def _build_mesh(self):
+        """One representative device per process — the engine's 'world'
+        ring. (Collectives over the full per-process device set belong to
+        the rabit_tpu.parallel layer, not the host API.)"""
+        import jax
+        from jax.sharding import Mesh
+        reps = {}
+        for d in jax.devices():
+            reps.setdefault(d.process_index, d)
+        devs = [reps[i] for i in sorted(reps)]
+        return Mesh(np.array(devs), ("proc",))
+
+    def shutdown(self) -> None:
+        pass
+
+    # -- collectives ------------------------------------------------------
+    def allreduce(self, buf: np.ndarray, op: int,
+                  prepare_fun: Optional[Callable[[], None]] = None,
+                  key: str = "") -> None:
+        if prepare_fun is not None:
+            prepare_fun()
+        if self._world == 1:
+            return
+        import contextlib
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.collectives import device_allreduce
+        n = buf.size
+        method = "ring" if n >= self._ring_mincount else "tree"
+        mesh = self._mesh
+        # 64-bit payloads: without x64, device_put silently truncates
+        # int64/float64 to 32 bits; scope-enable it for this reduction.
+        ctx = jax.experimental.enable_x64() if buf.dtype.itemsize == 8 \
+            else contextlib.nullcontext()
+        with ctx:
+            sharding = NamedSharding(mesh, P("proc"))
+            local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
+            xs = jax.make_array_from_single_device_arrays(
+                (self._world, n), sharding, [local])
+            out = device_allreduce(xs, mesh, op, axis="proc", method=method)
+            res = np.asarray(out.addressable_data(0)).reshape(-1)
+        if res.dtype != buf.dtype:
+            raise TypeError(
+                f"device allreduce changed dtype {buf.dtype} -> {res.dtype}")
+        np.copyto(buf, res)
+        if self._debug:
+            log_info("xla allreduce n=%d op=%d method=%s", n, op, method)
+
+    def broadcast(self, data: Optional[bytes], root: int) -> bytes:
+        if self._world == 1:
+            if data is None:
+                raise ValueError(
+                    "single-process broadcast must originate data")
+            return data
+        # Two phases like the reference binding (rabit.py:171-206):
+        # 1) agree on length (tiny MAX allreduce), 2) ship payload.
+        nlen = np.zeros(1, dtype=np.int64)
+        if self._rank == root:
+            nlen[0] = len(data)
+        from ..ops.reducers import MAX as OP_MAX
+        self.allreduce(nlen, OP_MAX)
+        size = int(nlen[0])
+        payload = np.zeros(size, dtype=np.uint8)
+        if self._rank == root:
+            payload[:] = np.frombuffer(data, dtype=np.uint8)
+        self._device_bcast(payload, root)
+        return payload.tobytes()
+
+    def _device_bcast(self, buf: np.ndarray, root: int) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.collectives import device_broadcast
+        mesh = self._mesh
+        n = buf.size
+        sharding = NamedSharding(mesh, P("proc"))
+        local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
+        xs = jax.make_array_from_single_device_arrays(
+            (self._world, n), sharding, [local])
+        out = device_broadcast(xs, mesh, root=root, axis="proc")
+        np.copyto(buf, np.asarray(out.addressable_data(0)).reshape(-1))
+
+    # -- checkpointing ----------------------------------------------------
+    # In-memory, version-prefixed, like the reference's global_checkpoint
+    # string (allreduce_robust.cc:443-451). Replay/recovery semantics are
+    # provided by the robust C++ engine; here checkpoints make single- and
+    # healthy-multi-process runs resumable in-process.
+    def load_checkpoint(self, with_local: bool = False
+                        ) -> Tuple[int, Optional[bytes], Optional[bytes]]:
+        self._materialize_lazy()
+        return (self._version, self._global, self._local)
+
+    def checkpoint(self, global_bytes: bytes,
+                   local_bytes: Optional[bytes] = None) -> None:
+        self._global = global_bytes
+        self._local = local_bytes
+        self._lazy = None
+        self._version += 1
+
+    def lazy_checkpoint(self, make_global: Callable[[], bytes]) -> None:
+        self._lazy = make_global
+        self._local = None
+        self._version += 1
+
+    def _materialize_lazy(self) -> None:
+        if self._lazy is not None:
+            self._global = self._lazy()
+            self._lazy = None
+
+    # -- properties -------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world

@@ -1,0 +1,4 @@
+"""Demo workloads — the use cases the reference names for its API
+(doc/guide.md:137-143: L-BFGS gradient aggregation, KMeans statistics,
+tree-boosting split/histogram statistics) plus the flagship hand-sharded
+SPMD training step used by the driver's compile checks."""

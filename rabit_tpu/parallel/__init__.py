@@ -1,0 +1,18 @@
+"""Device-mesh collectives — the TPU-native data plane.
+
+The reference implements its collectives as poll()-driven TCP state
+machines (tree: allreduce_base.cc:475-640, ring: .cc:751-949). Here the
+same algorithm family is expressed as XLA programs over a
+``jax.sharding.Mesh``: the tree path is XLA's built-in ``psum``/``pmax``
+(which lowers to the optimal ICI reduction), and the ring path is an
+explicit ``ppermute`` pipeline (ring reduce-scatter + ring all-gather) —
+the same neighbor-exchange structure as the reference's ring engine and
+as ring attention.
+"""
+
+from .mesh import make_mesh, best_mesh_axis  # noqa: F401
+from .collectives import (  # noqa: F401
+    ring_reduce_scatter, ring_all_gather, ring_allreduce,
+    tree_allreduce, bcast_from_root,
+    device_allreduce, device_broadcast, RING_MINCOUNT_DEFAULT,
+)

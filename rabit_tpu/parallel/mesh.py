@@ -1,0 +1,46 @@
+"""Mesh construction helpers.
+
+The reference's topology comes from the tracker (tree neighbor sets +
+ring prev/next, allreduce_base.cc:264-441). On TPU the physical topology
+is the ICI torus; a ``jax.sharding.Mesh`` over ``jax.devices()`` lets XLA
+pick torus-optimal collective schedules, so "topology wiring" reduces to
+choosing mesh axes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("workers",),
+              shape: Optional[Tuple[int, ...]] = None) -> Mesh:
+    """Build a mesh over the first ``n_devices`` devices.
+
+    With one axis the mesh is a flat ring (the engine's world); with
+    ``shape`` given, a multi-axis mesh (e.g. ``("dp","tp")``) for the
+    model-parallel demos.
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if n_devices > len(devs):
+        raise ValueError(f"asked for {n_devices} devices, have {len(devs)}")
+    devs = devs[:n_devices]
+    if shape is None:
+        shape = (n_devices,) if len(axis_names) == 1 else None
+    if shape is None:
+        raise ValueError("shape required for multi-axis mesh")
+    if int(np.prod(shape)) != n_devices:
+        raise ValueError(f"shape {shape} != {n_devices} devices")
+    return Mesh(np.array(devs).reshape(shape), tuple(axis_names))
+
+
+def best_mesh_axis(mesh: Mesh) -> str:
+    """The largest axis — where collectives get the most parallelism."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return max(sizes, key=sizes.get)

@@ -1,0 +1,3 @@
+"""Rendezvous tracker — rank assignment, topology, restart orchestration
+(the reference outsources this to dmlc-core's tracker; ours is built in,
+SURVEY §7 step 2)."""

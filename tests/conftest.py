@@ -5,11 +5,19 @@ dryrun_multichip uses the same mechanism). Must run before jax imports."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The image's sitecustomize may import jax and register the axon TPU
+# platform (one real chip) at interpreter startup — before this conftest
+# runs. Backend initialization is lazy, so switching the platform to CPU
+# via jax.config still works here as long as no jax computation ran yet.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
