@@ -1,0 +1,61 @@
+/*
+ * rabit_tpu flat C ABI — capability parity with the reference
+ * include/rabit/c_api.h (c_api.h:37-164): init/finalize, rank/world
+ * queries, tracker print, in-place allreduce with runtime op x dtype
+ * dispatch, broadcast, pickle-friendly checkpoint wrappers. Fresh
+ * additions: an explicit cache-key argument so bindings can keep
+ * caller-signature replay keys (the reference loses them across its C
+ * ABI), and an engine-variant selector.
+ */
+#ifndef RABIT_TPU_C_H_
+#define RABIT_TPU_C_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* op enums: max=0 min=1 sum=2 bitor=3 (engine.h:195-200)
+ * dtype enums: int8..float64 = 0..7 (rabit.py:209-218) */
+
+/* argv-style "key=value" config strings */
+int RbtInit(int argc, const char** argv);
+int RbtFinalize(void);
+int RbtGetRank(void);
+int RbtGetWorldSize(void);
+int RbtIsDistributed(void);
+int RbtTrackerPrint(const char* msg);
+/* writes up to *len bytes into buf; sets *len to the full length */
+int RbtGetProcessorName(char* buf, size_t* len, size_t max_len);
+
+int RbtAllreduce(void* sendrecvbuf, size_t count, int dtype, int op,
+                 void (*prepare_fun)(void*), void* prepare_arg);
+/* same, with a replay cache key (bootstrap cache, rabit.h:26-39) */
+int RbtAllreduceEx(void* sendrecvbuf, size_t count, int dtype, int op,
+                   void (*prepare_fun)(void*), void* prepare_arg,
+                   const char* cache_key);
+int RbtBroadcast(void* sendrecvbuf, uint64_t size, int root);
+/* same, with a replay cache key (bootstrap cache) */
+int RbtBroadcastEx(void* sendrecvbuf, uint64_t size, int root,
+                   const char* cache_key);
+
+/* returns version number (0 = nothing checkpointed); out pointers are
+ * owned by the library and valid until the next checkpoint call
+ * (reference c_api.cc:219-245 static-buffer contract) */
+int RbtLoadCheckpoint(const char** out_global, uint64_t* out_global_len,
+                      const char** out_local, uint64_t* out_local_len);
+int RbtCheckpoint(const char* global, uint64_t global_len,
+                  const char* local, uint64_t local_len);
+int RbtLazyCheckpoint(const char* global, uint64_t global_len);
+int RbtVersionNumber(void);
+
+/* last error message for bindings (empty string if none) */
+const char* RbtGetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* RABIT_TPU_C_H_ */

@@ -1,0 +1,186 @@
+// C ABI implementation (reference src/c_api.cc). All entry points catch
+// rt::Error and surface it through RbtGetLastError (return -1), so the
+// ctypes binding can raise Python exceptions instead of aborting the
+// interpreter.
+#include "../include/rabit_tpu_c.h"
+
+#include <cstring>
+#include <string>
+
+#include "comm.h"
+#include "mock.h"
+#include "robust.h"
+
+namespace rt {
+
+// engine-variant factory: rabit_engine=base|robust|mock (the reference
+// selects at link time via librabit/_base/_mock; we select at runtime)
+Comm* NewCommFromEnv(int argc, const char* const* argv) {
+  Config cfg;
+  cfg.LoadEnv();
+  cfg.LoadArgs(argc, argv);
+  std::string variant = cfg.Get("rabit_engine", "robust");
+  if (!cfg.GetRepeated("mock").empty() ||
+      !cfg.GetRepeated("rabit_mock").empty()) {
+    variant = "mock";
+  }
+  if (variant == "base" || variant == "native") return new Comm();
+  if (variant == "mock") return new MockComm();
+  return new RobustComm();
+}
+
+static std::string& LastError() {
+  static std::string err;
+  return err;
+}
+
+}  // namespace rt
+
+using rt::GetComm;
+
+#define RT_API_BEGIN() try {
+#define RT_API_END()                         \
+  }                                          \
+  catch (const std::exception& e) {          \
+    rt::LastError() = e.what();              \
+    return -1;                               \
+  }                                          \
+  return 0;
+
+extern "C" {
+
+const char* RbtGetLastError(void) { return rt::LastError().c_str(); }
+
+int RbtInit(int argc, const char** argv) {
+  RT_API_BEGIN();
+  rt::InitComm(argc, argv);
+  RT_API_END();
+}
+
+int RbtFinalize(void) {
+  RT_API_BEGIN();
+  rt::FinalizeComm();
+  RT_API_END();
+}
+
+int RbtGetRank(void) {
+  try {
+    return GetComm()->rank();
+  } catch (const std::exception& e) {
+    rt::LastError() = e.what();
+    return -1;
+  }
+}
+
+int RbtGetWorldSize(void) {
+  try {
+    return GetComm()->world_size();
+  } catch (const std::exception& e) {
+    rt::LastError() = e.what();
+    return -1;
+  }
+}
+
+int RbtIsDistributed(void) {
+  try {
+    return GetComm()->is_distributed() ? 1 : 0;
+  } catch (const std::exception& e) {
+    rt::LastError() = e.what();
+    return -1;
+  }
+}
+
+int RbtTrackerPrint(const char* msg) {
+  RT_API_BEGIN();
+  GetComm()->TrackerPrint(msg ? msg : "");
+  RT_API_END();
+}
+
+int RbtGetProcessorName(char* buf, size_t* len, size_t max_len) {
+  RT_API_BEGIN();
+  const std::string& h = GetComm()->host();
+  size_t n = h.size() < max_len ? h.size() : max_len;
+  memcpy(buf, h.data(), n);
+  if (n < max_len) buf[n] = '\0';
+  *len = h.size();
+  RT_API_END();
+}
+
+int RbtAllreduceEx(void* sendrecvbuf, size_t count, int dtype, int op,
+                   void (*prepare_fun)(void*), void* prepare_arg,
+                   const char* cache_key) {
+  RT_API_BEGIN();
+  rt::ReduceFn fn = rt::GetReducer(op, dtype);
+  GetComm()->Allreduce(sendrecvbuf, rt::DTypeSize(dtype), count, fn,
+                       prepare_fun, prepare_arg, cache_key ? cache_key : "");
+  RT_API_END();
+}
+
+int RbtAllreduce(void* sendrecvbuf, size_t count, int dtype, int op,
+                 void (*prepare_fun)(void*), void* prepare_arg) {
+  return RbtAllreduceEx(sendrecvbuf, count, dtype, op, prepare_fun,
+                        prepare_arg, "");
+}
+
+int RbtBroadcastEx(void* sendrecvbuf, uint64_t size, int root,
+                   const char* cache_key) {
+  RT_API_BEGIN();
+  GetComm()->Broadcast(sendrecvbuf, static_cast<size_t>(size), root,
+                       cache_key ? cache_key : "");
+  RT_API_END();
+}
+
+int RbtBroadcast(void* sendrecvbuf, uint64_t size, int root) {
+  return RbtBroadcastEx(sendrecvbuf, size, root, "");
+}
+
+// static buffers keep checkpoints alive across the ABI (reference
+// c_api.cc:219-245; documented not thread-safe, as is the whole API)
+static std::string g_load_global, g_load_local;
+
+int RbtLoadCheckpoint(const char** out_global, uint64_t* out_global_len,
+                      const char** out_local, uint64_t* out_local_len) {
+  try {
+    int version = GetComm()->LoadCheckpoint(
+        &g_load_global, out_local ? &g_load_local : nullptr);
+    if (out_global) {
+      *out_global = g_load_global.data();
+      *out_global_len = g_load_global.size();
+    }
+    if (out_local) {
+      *out_local = g_load_local.data();
+      *out_local_len = g_load_local.size();
+    }
+    return version;
+  } catch (const std::exception& e) {
+    rt::LastError() = e.what();
+    return -1;
+  }
+}
+
+int RbtCheckpoint(const char* global, uint64_t global_len, const char* local,
+                  uint64_t local_len) {
+  RT_API_BEGIN();
+  GetComm()->Checkpoint(std::string(global ? global : "", global_len),
+                        std::string(local ? local : "", local_len));
+  RT_API_END();
+}
+
+int RbtLazyCheckpoint(const char* global, uint64_t global_len) {
+  RT_API_BEGIN();
+  static std::string lazy_buf;
+  lazy_buf.assign(global ? global : "", global_len);
+  GetComm()->LazyCheckpoint(&lazy_buf);
+  RT_API_END();
+}
+
+int RbtVersionNumber(void) {
+  try {
+    return GetComm()->version_number();
+  } catch (const std::exception& e) {
+    rt::LastError() = e.what();
+    return -1;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,509 @@
+#include "comm.h"
+
+#include <algorithm>
+#include <cstring>
+#include <map>
+
+namespace rt {
+
+static const uint32_t kTrackerMagic = 0x52425401;  // "RBT\x01"
+static const uint32_t kLinkMagic = 0x52425402;
+static const uint32_t kNoRank = 0xFFFFFFFFu;
+
+Comm::~Comm() { CloseLinks(); }
+
+void Comm::SetupFromConfig(const Config& cfg) {
+  tracker_uri_ = cfg.Get("rabit_tracker_uri");
+  if (tracker_uri_ == "NULL") tracker_uri_ = "";  // single-node escape,
+  // reference allreduce_base.cc:266-268
+  tracker_port_ = static_cast<int>(cfg.GetInt("rabit_tracker_port", 9091));
+  task_id_ = cfg.Get("rabit_task_id", "0");
+  num_attempt_ = static_cast<int>(cfg.GetInt("rabit_num_trial", 0));
+  ring_mincount_ = static_cast<size_t>(
+      cfg.GetInt("rabit_reduce_ring_mincount", 32 << 10));
+  reduce_buffer_ = cfg.GetSize("rabit_reduce_buffer", 256u << 20);
+  debug_ = cfg.GetBool("rabit_debug", false);
+  StopProcessOnError() =
+      cfg.GetBool("rabit_stop_process_on_error", false) ||
+      // DMLC_WORKER_STOP_PROCESS_ON_ERROR normalizes to this key
+      cfg.GetBool("rabit_worker_stop_process_on_error", false);
+  host_ = GetHostName();
+}
+
+void Comm::Init(int argc, const char* const* argv) {
+  cfg_.LoadEnv();
+  cfg_.LoadArgs(argc, argv);
+  SetupFromConfig(cfg_);
+  if (tracker_uri_.empty()) {
+    rank_ = 0;
+    world_ = 1;
+    return;
+  }
+  ReconnectLinks("start");
+}
+
+void Comm::Shutdown() {
+  if (tracker_uri_.empty()) return;
+  if (links_up_) {
+    TcpConn t = ConnectTrackerCmd("shutdown");
+    // tracker acks so shutdown is ordered before tracker teardown
+    t.RecvU32();
+  }
+  CloseLinks();
+  listener_.Close();
+}
+
+void Comm::TrackerPrint(const std::string& msg) {
+  if (tracker_uri_.empty()) {
+    fprintf(stdout, "%s\n", msg.c_str());
+    fflush(stdout);
+    return;
+  }
+  TcpConn t = ConnectTrackerCmd("print");
+  t.SendStr(msg);
+  t.RecvU32();  // ack
+}
+
+TcpConn Comm::ConnectTrackerCmd(const std::string& cmd) {
+  TcpConn t = TcpConn::Connect(tracker_uri_, tracker_port_);
+  t.SendU32(kTrackerMagic);
+  t.SendStr(cmd);
+  t.SendStr(task_id_);
+  t.SendU32(static_cast<uint32_t>(num_attempt_));
+  return t;
+}
+
+void Comm::CloseLinks() {
+  links_.clear();
+  tree_idx_.clear();
+  parent_pos_ = -1;
+  ring_prev_ = ring_next_ = -1;
+  links_up_ = false;
+}
+
+void Comm::ReconnectLinks(const char* cmd) {
+  CloseLinks();
+  if (listener_.fd() < 0) {
+    listener_.Bind(static_cast<int>(cfg_.GetInt("rabit_slave_port", 9010)));
+  }
+  TcpConn t = ConnectTrackerCmd(cmd);
+  t.SendStr(host_);
+  t.SendU32(static_cast<uint32_t>(listener_.port()));
+
+  // Assignment (tracker barriers until all world_size workers register,
+  // so every peer below is already listening).
+  rank_ = static_cast<int>(t.RecvU32());
+  world_ = static_cast<int>(t.RecvU32());
+  uint32_t parent_rank = t.RecvU32();
+  uint32_t ntree = t.RecvU32();
+  std::vector<int> tree_ranks(ntree);
+  for (auto& r : tree_ranks) r = static_cast<int>(t.RecvU32());
+  int prev_rank = static_cast<int>(t.RecvU32());
+  int next_rank = static_cast<int>(t.RecvU32());
+
+  uint32_t nconnect = t.RecvU32();
+  std::map<int, TcpConn> conns;
+  for (uint32_t i = 0; i < nconnect; ++i) {
+    int peer = static_cast<int>(t.RecvU32());
+    std::string phost = t.RecvStr();
+    int pport = static_cast<int>(t.RecvU32());
+    TcpConn c = TcpConn::Connect(phost, pport);
+    c.SendU32(kLinkMagic);
+    c.SendU32(static_cast<uint32_t>(rank_));
+    uint32_t got = c.RecvU32();
+    RT_CHECK(static_cast<int>(got) == peer,
+             StrFormat("link handshake: expected rank %d got %u", peer, got));
+    conns.emplace(peer, std::move(c));
+  }
+  uint32_t naccept = t.RecvU32();
+  for (uint32_t i = 0; i < naccept; ++i) {
+    TcpConn c = listener_.Accept();
+    uint32_t magic = c.RecvU32();
+    RT_CHECK(magic == kLinkMagic, "bad link magic");
+    int peer = static_cast<int>(c.RecvU32());
+    c.SendU32(static_cast<uint32_t>(rank_));
+    conns.emplace(peer, std::move(c));
+  }
+  // ready ack: tracker knows this worker finished wiring
+  t.SendU32(1u);
+
+  // index links
+  for (auto& kv : conns) {
+    Link l;
+    l.peer_rank = kv.first;
+    l.conn = std::move(kv.second);
+    l.conn.SetKeepAlive();
+    links_.push_back(std::move(l));
+  }
+  auto find_link = [&](int r) {
+    for (size_t i = 0; i < links_.size(); ++i)
+      if (links_[i].peer_rank == r) return static_cast<int>(i);
+    Fail(StrFormat("rank %d not among established links", r));
+    return -1;
+  };
+  for (int r : tree_ranks) tree_idx_.push_back(find_link(r));
+  if (parent_rank != kNoRank) {
+    for (size_t i = 0; i < tree_ranks.size(); ++i)
+      if (tree_ranks[i] == static_cast<int>(parent_rank))
+        parent_pos_ = static_cast<int>(i);
+    RT_CHECK(parent_pos_ >= 0, "parent not in tree neighbor list");
+  } else {
+    parent_pos_ = -1;
+  }
+  if (world_ > 1) {
+    ring_prev_ = find_link(prev_rank);
+    ring_next_ = find_link(next_rank);
+  }
+  for (auto& l : links_) l.conn.SetNonBlocking(true);
+  links_up_ = true;
+  if (debug_) {
+    LogInfo(StrFormat("rank %d/%d links up (%zu links, parent %s)", rank_,
+                      world_, links_.size(),
+                      parent_pos_ < 0 ? "none" : "yes"));
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Collectives
+// ---------------------------------------------------------------------------
+
+void Comm::Allreduce(void* buf, size_t elem_size, size_t count,
+                     ReduceFn reducer, PrepareFn prepare, void* prepare_arg,
+                     const char*) {
+  if (prepare != nullptr) prepare(prepare_arg);
+  NetResult r = TryAllreduce(buf, elem_size, count, reducer);
+  RT_CHECK(r == NetResult::kOk, "allreduce failed (no recovery in base "
+                                "engine; use the robust engine)");
+}
+
+void Comm::Broadcast(void* buf, size_t size, int root, const char*) {
+  NetResult r = TryBroadcast(static_cast<char*>(buf), size, root);
+  RT_CHECK(r == NetResult::kOk, "broadcast failed (no recovery in base "
+                                "engine; use the robust engine)");
+}
+
+int Comm::LoadCheckpoint(std::string* global, std::string* local) {
+  if (global) global->clear();
+  if (local) local->clear();
+  return 0;  // base engine: not fault tolerant (like engine_mpi.cc:47-60)
+}
+
+void Comm::Checkpoint(const std::string&, const std::string&) {
+  ++version_;
+}
+
+void Comm::LazyCheckpoint(const std::string*) { ++version_; }
+
+NetResult Comm::TryAllreduce(void* buf, size_t elem_size, size_t count,
+                             ReduceFn reducer) {
+  if (world_ == 1 || count == 0) return NetResult::kOk;
+  // the crossover the reference documents but never wires (SURVEY §2 #3)
+  if (count >= ring_mincount_ && world_ > 2) {
+    return TryAllreduceRing(static_cast<char*>(buf), elem_size, count,
+                            reducer);
+  }
+  return TryAllreduceTree(static_cast<char*>(buf), elem_size, count, reducer);
+}
+
+// Streaming tree allreduce: reduce up from children while broadcasting
+// results down from the root, all links nonblocking under one poll loop
+// (reference TryAllreduceTree, allreduce_base.cc:475-640). Down-writes
+// into buf are safe because result byte i only arrives after byte i was
+// sent up (same invariant as the reference's single-buffer design).
+NetResult Comm::TryAllreduceTree(char* buf, size_t elem_size, size_t count,
+                                 ReduceFn reducer) {
+  const size_t total = elem_size * count;
+  std::vector<int> children;
+  int parent_link = -1;
+  for (size_t i = 0; i < tree_idx_.size(); ++i) {
+    if (static_cast<int>(i) == parent_pos_) parent_link = tree_idx_[i];
+    else children.push_back(tree_idx_[i]);
+  }
+  // segment boundary must be element-aligned or the fold can never
+  // reach S (and the next segment would start mid-element)
+  const size_t seg_max =
+      std::max<size_t>(reduce_buffer_ / elem_size, 1) * elem_size;
+
+  for (size_t seg_off = 0; seg_off < total; seg_off += seg_max) {
+    const size_t S = std::min(seg_max, total - seg_off);
+    char* base = buf + seg_off;
+    std::vector<std::vector<char>> cbuf(children.size());
+    std::vector<size_t> crecv(children.size(), 0);
+    for (auto& b : cbuf) b.resize(S);
+    size_t reduced = children.empty() ? S : 0;
+    size_t sent_up = 0;
+    size_t down_recv = (parent_link < 0) ? reduced : 0;
+    std::vector<size_t> down_sent(children.size(), 0);
+
+    auto done = [&]() {
+      if (down_recv < S) return false;
+      for (size_t c = 0; c < children.size(); ++c)
+        if (down_sent[c] < S) return false;
+      if (parent_link >= 0 && sent_up < S) return false;
+      return true;
+    };
+
+    while (!done()) {
+      Poller poll;
+      bool watching = false;
+      for (size_t c = 0; c < children.size(); ++c) {
+        if (crecv[c] < S) {
+          poll.WatchRead(links_[children[c]].conn.fd());
+          watching = true;
+        }
+        if (down_sent[c] < down_recv) {
+          poll.WatchWrite(links_[children[c]].conn.fd());
+          watching = true;
+        }
+      }
+      if (parent_link >= 0) {
+        if (sent_up < reduced) {
+          poll.WatchWrite(links_[parent_link].conn.fd());
+          watching = true;
+        }
+        if (down_recv < S) {
+          poll.WatchRead(links_[parent_link].conn.fd());
+          watching = true;
+        }
+      }
+      if (watching) {
+        if (poll.Wait(-1) < 0) return NetResult::kError;
+      }
+      NetResult res;
+      // children -> us (reduce direction)
+      for (size_t c = 0; c < children.size(); ++c) {
+        auto& conn = links_[children[c]].conn;
+        if (crecv[c] < S && poll.CanRead(conn.fd())) {
+          ssize_t k = conn.TryRecv(cbuf[c].data() + crecv[c], S - crecv[c],
+                                   &res);
+          if (k < 0) return res;
+          crecv[c] += static_cast<size_t>(k);
+        }
+      }
+      // fold newly complete region
+      if (!children.empty()) {
+        size_t minc = S;
+        for (size_t c = 0; c < children.size(); ++c)
+          minc = std::min(minc, crecv[c]);
+        size_t aligned = (minc / elem_size) * elem_size;
+        if (aligned > reduced) {
+          for (size_t c = 0; c < children.size(); ++c) {
+            reducer(base + reduced, cbuf[c].data() + reduced,
+                    (aligned - reduced) / elem_size);
+          }
+          reduced = aligned;
+        }
+      }
+      if (parent_link < 0) {
+        down_recv = reduced;  // root: result is the reduced prefix
+      } else {
+        auto& pconn = links_[parent_link].conn;
+        if (sent_up < reduced && poll.CanWrite(pconn.fd())) {
+          ssize_t k = pconn.TrySend(base + sent_up, reduced - sent_up, &res);
+          if (k < 0) return res;
+          sent_up += static_cast<size_t>(k);
+        }
+        if (down_recv < S && sent_up > down_recv &&
+            poll.CanRead(pconn.fd())) {
+          // result bytes never outrun what we sent up
+          ssize_t k = pconn.TryRecv(base + down_recv, sent_up - down_recv,
+                                    &res);
+          if (k < 0) return res;
+          down_recv += static_cast<size_t>(k);
+        }
+      }
+      // us -> children (broadcast direction)
+      for (size_t c = 0; c < children.size(); ++c) {
+        auto& conn = links_[children[c]].conn;
+        if (down_sent[c] < down_recv && poll.CanWrite(conn.fd())) {
+          ssize_t k = conn.TrySend(base + down_sent[c],
+                                   down_recv - down_sent[c], &res);
+          if (k < 0) return res;
+          down_sent[c] += static_cast<size_t>(k);
+        }
+      }
+    }
+  }
+  return NetResult::kOk;
+}
+
+// Tree broadcast with dynamic in-link discovery: whichever tree neighbor
+// sends first is upstream; forward chunks to every other tree link as
+// they arrive (reference TryBroadcast, allreduce_base.cc:649-737).
+NetResult Comm::TryBroadcast(char* buf, size_t size, int root) {
+  if (world_ == 1 || size == 0) return NetResult::kOk;
+  const bool is_root = (rank_ == root);
+  int in_link = is_root ? -2 : -1;  // -2: we originate; -1: unknown yet
+  size_t recvd = is_root ? size : 0;
+  std::vector<size_t> sent(tree_idx_.size(), 0);
+
+  auto done = [&]() {
+    if (recvd < size) return false;
+    for (size_t i = 0; i < tree_idx_.size(); ++i) {
+      if (static_cast<int>(i) == in_link) continue;
+      if (sent[i] < size) return false;
+    }
+    return true;
+  };
+
+  while (!done()) {
+    Poller poll;
+    for (size_t i = 0; i < tree_idx_.size(); ++i) {
+      auto& conn = links_[tree_idx_[i]].conn;
+      if (in_link == -1) poll.WatchRead(conn.fd());
+      if (static_cast<int>(i) == in_link && recvd < size)
+        poll.WatchRead(conn.fd());
+      if (static_cast<int>(i) != in_link && sent[i] < recvd)
+        poll.WatchWrite(conn.fd());
+    }
+    if (poll.Wait(-1) < 0) return NetResult::kError;
+    NetResult res;
+    if (in_link == -1) {
+      for (size_t i = 0; i < tree_idx_.size(); ++i) {
+        auto& conn = links_[tree_idx_[i]].conn;
+        if (poll.CanRead(conn.fd())) {
+          ssize_t k = conn.TryRecv(buf, size, &res);
+          if (k < 0) return res;
+          if (k > 0) {
+            in_link = static_cast<int>(i);
+            recvd = static_cast<size_t>(k);
+            break;
+          }
+        }
+      }
+    } else if (in_link >= 0 && recvd < size) {
+      auto& conn = links_[tree_idx_[in_link]].conn;
+      if (poll.CanRead(conn.fd())) {
+        ssize_t k = conn.TryRecv(buf + recvd, size - recvd, &res);
+        if (k < 0) return res;
+        recvd += static_cast<size_t>(k);
+      }
+    }
+    for (size_t i = 0; i < tree_idx_.size(); ++i) {
+      if (static_cast<int>(i) == in_link) continue;
+      auto& conn = links_[tree_idx_[i]].conn;
+      if (sent[i] < recvd && poll.CanWrite(conn.fd())) {
+        ssize_t k = conn.TrySend(buf + sent[i], recvd - sent[i], &res);
+        if (k < 0) return res;
+        sent[i] += static_cast<size_t>(k);
+      }
+    }
+  }
+  return NetResult::kOk;
+}
+
+std::vector<size_t> Comm::RingRanges(size_t count, size_t elem_size) const {
+  std::vector<size_t> off(world_ + 1, 0);
+  size_t base = count / world_, rem = count % world_;
+  for (int r = 0; r < world_; ++r) {
+    size_t n = base + (static_cast<size_t>(r) < rem ? 1 : 0);
+    off[r + 1] = off[r] + n * elem_size;
+  }
+  return off;
+}
+
+NetResult Comm::RingExchange(const char* send_buf, size_t send_n,
+                             char* recv_buf, size_t recv_n) {
+  auto& next = links_[ring_next_].conn;
+  auto& prev = links_[ring_prev_].conn;
+  size_t sent = 0, recvd = 0;
+  while (sent < send_n || recvd < recv_n) {
+    Poller poll;
+    if (sent < send_n) poll.WatchWrite(next.fd());
+    if (recvd < recv_n) poll.WatchRead(prev.fd());
+    if (poll.Wait(-1) < 0) return NetResult::kError;
+    NetResult res;
+    if (sent < send_n && poll.CanWrite(next.fd())) {
+      ssize_t k = next.TrySend(send_buf + sent, send_n - sent, &res);
+      if (k < 0) return res;
+      sent += static_cast<size_t>(k);
+    }
+    if (recvd < recv_n && poll.CanRead(prev.fd())) {
+      ssize_t k = prev.TryRecv(recv_buf + recvd, recv_n - recvd, &res);
+      if (k < 0) return res;
+      recvd += static_cast<size_t>(k);
+    }
+  }
+  return NetResult::kOk;
+}
+
+// Ring reduce-scatter: world-1 neighbor exchanges; after step s rank r
+// has accumulated s+2 contributions into range (r-s-2) mod P; rank r
+// ends owning range r fully reduced (reference TryReduceScatterRing,
+// allreduce_base.cc:829-918 — ownership offset differs; ours lands the
+// reduced range on its own rank index).
+NetResult Comm::TryReduceScatterRing(char* buf, size_t elem_size,
+                                     size_t count, ReduceFn reducer) {
+  const int P = world_;
+  auto off = RingRanges(count, elem_size);
+  std::vector<char> tmp(off[1] - off[0] + elem_size);
+  for (int s = 0; s < P - 1; ++s) {
+    int send_r = ((rank_ - s - 1) % P + P) % P;
+    int recv_r = ((rank_ - s - 2) % P + P) % P;
+    size_t send_n = off[send_r + 1] - off[send_r];
+    size_t recv_n = off[recv_r + 1] - off[recv_r];
+    if (tmp.size() < recv_n) tmp.resize(recv_n);
+    NetResult res = RingExchange(buf + off[send_r], send_n, tmp.data(),
+                                 recv_n);
+    if (res != NetResult::kOk) return res;
+    if (recv_n > 0) {
+      reducer(buf + off[recv_r], tmp.data(), recv_n / elem_size);
+    }
+  }
+  return NetResult::kOk;
+}
+
+// Ring all-gather: rank r starts owning range r; world-1 forwarding steps
+// (reference TryAllgatherRing, allreduce_base.cc:751-815).
+NetResult Comm::TryAllgatherRing(char* buf, size_t elem_size, size_t count) {
+  const int P = world_;
+  auto off = RingRanges(count, elem_size);
+  for (int s = 0; s < P - 1; ++s) {
+    int send_r = ((rank_ - s) % P + P) % P;
+    int recv_r = ((rank_ - s - 1) % P + P) % P;
+    NetResult res = RingExchange(buf + off[send_r],
+                                 off[send_r + 1] - off[send_r],
+                                 buf + off[recv_r],
+                                 off[recv_r + 1] - off[recv_r]);
+    if (res != NetResult::kOk) return res;
+  }
+  return NetResult::kOk;
+}
+
+NetResult Comm::TryAllreduceRing(char* buf, size_t elem_size, size_t count,
+                                 ReduceFn reducer) {
+  NetResult res = TryReduceScatterRing(buf, elem_size, count, reducer);
+  if (res != NetResult::kOk) return res;
+  return TryAllgatherRing(buf, elem_size, count);
+}
+
+// ---------------------------------------------------------------------------
+// Singleton
+// ---------------------------------------------------------------------------
+
+static std::unique_ptr<Comm>& CommSlot() {
+  static std::unique_ptr<Comm> slot;
+  return slot;
+}
+
+Comm* GetComm() {
+  RT_CHECK(CommSlot() != nullptr, "rabit_tpu native engine not initialized");
+  return CommSlot().get();
+}
+
+Comm* NewCommFromEnv(int argc, const char* const* argv);  // factory, capi.cc
+
+void InitComm(int argc, const char* const* argv) {
+  if (CommSlot() != nullptr) return;
+  CommSlot().reset(NewCommFromEnv(argc, argv));
+  CommSlot()->Init(argc, argv);
+}
+
+void FinalizeComm() {
+  if (CommSlot() != nullptr) {
+    CommSlot()->Shutdown();
+    CommSlot().reset();
+  }
+}
+
+}  // namespace rt

@@ -1,0 +1,105 @@
+// key=value configuration, mirroring the reference's parameter system
+// (env vars then argv overrides, allreduce_base.cc:42-68 + SetParam
+// chains; size suffixes .cc:156-176).
+#ifndef RT_CONFIG_H_
+#define RT_CONFIG_H_
+
+#include <cstdlib>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "log.h"
+
+namespace rt {
+
+class Config {
+ public:
+  static std::string Normalize(std::string k) {
+    for (auto& c : k) c = static_cast<char>(tolower(c));
+    if (k.rfind("dmlc_", 0) == 0) k = "rabit_" + k.substr(5);
+    return k;
+  }
+
+  void Set(const std::string& key, const std::string& val) {
+    std::string k = Normalize(key);
+    if (k == "rabit_mock" || k == "mock") {
+      repeated_[k].push_back(val);
+    } else {
+      values_[k] = val;
+    }
+  }
+
+  void LoadEnv() {
+    static const char* kEnv[] = {
+        "DMLC_TASK_ID", "DMLC_NUM_ATTEMPT", "DMLC_TRACKER_URI",
+        "DMLC_TRACKER_PORT", "DMLC_WORKER_STOP_PROCESS_ON_ERROR",
+        "RABIT_TASK_ID", "RABIT_TRACKER_URI", "RABIT_TRACKER_PORT",
+        "RABIT_NUM_TRIAL", "RABIT_BOOTSTRAP_CACHE", "RABIT_DEBUG",
+        "RABIT_WORLD_SIZE", "rabit_world_size",
+        "RABIT_REDUCE_RING_MINCOUNT", "rabit_reduce_ring_mincount",
+        "RABIT_REDUCE_BUFFER", "rabit_reduce_buffer",
+        "RABIT_GLOBAL_REPLICA", "rabit_global_replica",
+        "RABIT_LOCAL_REPLICA", "rabit_local_replica"};
+    for (const char* name : kEnv) {
+      const char* v = getenv(name);
+      if (v != nullptr) Set(name, v);
+    }
+  }
+
+  void LoadArgs(int argc, const char* const* argv) {
+    for (int i = 0; i < argc; ++i) {
+      std::string a(argv[i]);
+      auto eq = a.find('=');
+      if (eq != std::string::npos) Set(a.substr(0, eq), a.substr(eq + 1));
+    }
+  }
+
+  std::string Get(const std::string& key, const std::string& dflt = "") const {
+    auto it = values_.find(Normalize(key));
+    return it == values_.end() ? dflt : it->second;
+  }
+
+  long GetInt(const std::string& key, long dflt = 0) const {
+    std::string v = Get(key);
+    return v.empty() ? dflt : atol(v.c_str());
+  }
+
+  bool GetBool(const std::string& key, bool dflt = false) const {
+    std::string v = Get(key);
+    if (v.empty()) return dflt;
+    return v == "1" || v == "true" || v == "yes" || v == "on";
+  }
+
+  // "256MB" / "1G" / "1024" -> bytes
+  size_t GetSize(const std::string& key, size_t dflt = 0) const {
+    std::string v = Get(key);
+    if (v.empty()) return dflt;
+    char* end = nullptr;
+    double x = strtod(v.c_str(), &end);
+    std::string suffix(end);
+    for (auto& c : suffix) c = static_cast<char>(toupper(c));
+    size_t mult = 1;
+    if (suffix == "K" || suffix == "KB") mult = 1ull << 10;
+    else if (suffix == "M" || suffix == "MB") mult = 1ull << 20;
+    else if (suffix == "G" || suffix == "GB") mult = 1ull << 30;
+    else if (suffix == "B" || suffix.empty()) mult = 1;
+    else Fail("bad size suffix: " + v);
+    return static_cast<size_t>(x * mult);
+  }
+
+  std::vector<std::string> GetRepeated(const std::string& key) const {
+    std::vector<std::string> out;
+    auto it = repeated_.find(Normalize(key));
+    if (it != repeated_.end()) out = it->second;
+    return out;
+  }
+
+ private:
+  std::map<std::string, std::string> values_;
+  std::map<std::string, std::vector<std::string>> repeated_;
+};
+
+}  // namespace rt
+
+#endif  // RT_CONFIG_H_

@@ -1,0 +1,63 @@
+// Logging + invariant checks for the rabit_tpu native core.
+// Capability parity with reference include/rabit/internal/utils.h
+// (Assert/Check/Error with configurable die-vs-throw, utils.h:65-95),
+// redesigned around C++ exceptions: the engine throws rt::Error unless
+// RABIT_STOP_PROCESS_ON_ERROR is set, in which case it exits(-1) like
+// the reference default.
+#ifndef RT_LOG_H_
+#define RT_LOG_H_
+
+#include <cstdarg>
+#include <cstdio>
+#include <cstdlib>
+#include <stdexcept>
+#include <string>
+
+namespace rt {
+
+class Error : public std::runtime_error {
+ public:
+  explicit Error(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+// Set from config rabit_stop_process_on_error /
+// DMLC_WORKER_STOP_PROCESS_ON_ERROR (reference allreduce_base.cc:202-210).
+inline bool& StopProcessOnError() {
+  static bool v = false;
+  return v;
+}
+
+inline std::string StrFormat(const char* fmt, ...) {
+  va_list args;
+  va_start(args, fmt);
+  char buf[1024];
+  vsnprintf(buf, sizeof(buf), fmt, args);
+  va_end(args);
+  return std::string(buf);
+}
+
+[[noreturn]] inline void Fail(const std::string& msg) {
+  if (StopProcessOnError()) {
+    fprintf(stderr, "[rabit_tpu] fatal: %s\n", msg.c_str());
+    fflush(stderr);
+    exit(-1);
+  }
+  throw Error(msg);
+}
+
+inline void LogInfo(const std::string& msg) {
+  fprintf(stderr, "[rabit_tpu] %s\n", msg.c_str());
+  fflush(stderr);
+}
+
+}  // namespace rt
+
+#define RT_CHECK(cond, msg)                                              \
+  do {                                                                   \
+    if (!(cond)) {                                                       \
+      ::rt::Fail(::rt::StrFormat("check failed %s:%d: %s", __FILE__,     \
+                                 __LINE__, std::string(msg).c_str()));   \
+    }                                                                    \
+  } while (0)
+
+#endif  // RT_LOG_H_

@@ -1,0 +1,56 @@
+// MockComm — scripted fault injection for recovery testing.
+// Capability parity with the reference AllreduceMock
+// (src/allreduce_mock.h): repeated ``mock=rank,version,seqno,ntrial``
+// parameters script a process kill (exit 255) at exactly that engine
+// call, with ntrial fed from the tracker's restart-attempt counter so
+// each respawn advances the schedule (allreduce_mock.h:34-44,149-181).
+#ifndef RT_MOCK_H_
+#define RT_MOCK_H_
+
+#include <cstdio>
+#include <cstdlib>
+#include <set>
+#include <tuple>
+
+#include "robust.h"
+
+namespace rt {
+
+class MockComm : public RobustComm {
+ public:
+  void Init(int argc, const char* const* argv) override {
+    RobustComm::Init(argc, argv);
+    auto entries = cfg_.GetRepeated("rabit_mock");
+    auto more = cfg_.GetRepeated("mock");
+    entries.insert(entries.end(), more.begin(), more.end());
+    for (const auto& e : entries) {
+      int r = -1, v = -1, s = -1, t = -1;
+      if (sscanf(e.c_str(), "%d,%d,%d,%d", &r, &v, &s, &t) == 4) {
+        kill_points_.insert(std::make_tuple(r, v, s, t));
+      } else {
+        Fail("bad mock entry (want rank,version,seqno,ntrial): " + e);
+      }
+    }
+  }
+
+ protected:
+  void OnEngineCall(const char* fn) override {
+    auto key = std::make_tuple(rank_, version_,
+                               static_cast<int>(seq_counter_), num_attempt_);
+    if (kill_points_.count(key)) {
+      fprintf(stderr,
+              "[mock] rank %d killing itself at %s "
+              "(version=%d seq=%u trial=%d)\n",
+              rank_, fn, version_, seq_counter_, num_attempt_);
+      fflush(stderr);
+      exit(255);
+    }
+  }
+
+ private:
+  std::set<std::tuple<int, int, int, int>> kill_points_;
+};
+
+}  // namespace rt
+
+#endif  // RT_MOCK_H_

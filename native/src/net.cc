@@ -1,0 +1,246 @@
+#include "net.h"
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <thread>
+
+#include "log.h"
+
+namespace rt {
+
+TcpConn& TcpConn::operator=(TcpConn&& o) noexcept {
+  if (this != &o) {
+    Close();
+    fd_ = o.fd_;
+    o.fd_ = -1;
+  }
+  return *this;
+}
+
+void TcpConn::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+static sockaddr_in ResolveV4(const std::string& host, int port) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host.c_str(), &addr.sin_addr) != 1) {
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    int rc = getaddrinfo(host.c_str(), nullptr, &hints, &res);
+    RT_CHECK(rc == 0 && res != nullptr,
+             StrFormat("cannot resolve host %s", host.c_str()));
+    addr.sin_addr = reinterpret_cast<sockaddr_in*>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
+  return addr;
+}
+
+TcpConn TcpConn::Connect(const std::string& host, int port, int retries,
+                         int delay_ms) {
+  sockaddr_in addr = ResolveV4(host, port);
+  for (int attempt = 0;; ++attempt) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    RT_CHECK(fd >= 0, "socket() failed");
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      TcpConn c(fd);
+      c.SetNoDelay();
+      return c;
+    }
+    ::close(fd);
+    if (attempt >= retries) {
+      Fail(StrFormat("connect %s:%d failed after %d attempts: %s",
+                     host.c_str(), port, retries, strerror(errno)));
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
+  }
+}
+
+void TcpConn::SetNonBlocking(bool on) {
+  int flags = fcntl(fd_, F_GETFL, 0);
+  if (on) flags |= O_NONBLOCK; else flags &= ~O_NONBLOCK;
+  RT_CHECK(fcntl(fd_, F_SETFL, flags) == 0, "fcntl failed");
+}
+
+void TcpConn::SetNoDelay() {
+  int one = 1;
+  setsockopt(fd_, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+void TcpConn::SetKeepAlive() {
+  int one = 1;
+  setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+}
+
+void TcpConn::SendAll(const void* data, size_t n) {
+  const char* p = static_cast<const char*>(data);
+  while (n > 0) {
+    ssize_t k = ::send(fd_, p, n, MSG_NOSIGNAL);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        pollfd pfd{fd_, POLLOUT, 0};
+        ::poll(&pfd, 1, -1);
+        continue;
+      }
+      Fail(StrFormat("send failed: %s", strerror(errno)));
+    }
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+}
+
+void TcpConn::RecvAll(void* data, size_t n) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    ssize_t k = ::recv(fd_, p, n, 0);
+    if (k < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        pollfd pfd{fd_, POLLIN, 0};
+        ::poll(&pfd, 1, -1);
+        continue;
+      }
+      Fail(StrFormat("recv failed: %s", strerror(errno)));
+    }
+    RT_CHECK(k != 0, "connection closed by peer during RecvAll");
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+}
+
+void TcpConn::SendU32(uint32_t v) { SendAll(&v, sizeof(v)); }
+uint32_t TcpConn::RecvU32() {
+  uint32_t v = 0;
+  RecvAll(&v, sizeof(v));
+  return v;
+}
+
+void TcpConn::SendStr(const std::string& s) {
+  SendU32(static_cast<uint32_t>(s.size()));
+  SendAll(s.data(), s.size());
+}
+
+std::string TcpConn::RecvStr() {
+  uint32_t n = RecvU32();
+  std::string s(n, '\0');
+  if (n) RecvAll(&s[0], n);
+  return s;
+}
+
+ssize_t TcpConn::TrySend(const void* data, size_t n, NetResult* res) {
+  ssize_t k = ::send(fd_, data, n, MSG_NOSIGNAL);
+  if (k >= 0) {
+    *res = NetResult::kOk;
+    return k;
+  }
+  if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+    *res = NetResult::kAgain;
+    return 0;
+  }
+  *res = (errno == ECONNRESET || errno == EPIPE) ? NetResult::kReset
+                                                 : NetResult::kError;
+  return -1;
+}
+
+ssize_t TcpConn::TryRecv(void* data, size_t n, NetResult* res) {
+  ssize_t k = ::recv(fd_, data, n, 0);
+  if (k > 0) {
+    *res = NetResult::kOk;
+    return k;
+  }
+  if (k == 0) {  // orderly shutdown == peer death (reference
+                 // allreduce_base.h:320-323)
+    *res = NetResult::kReset;
+    return -1;
+  }
+  if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) {
+    *res = NetResult::kAgain;
+    return 0;
+  }
+  *res = (errno == ECONNRESET) ? NetResult::kReset : NetResult::kError;
+  return -1;
+}
+
+void Listener::Bind(int port_start, int ntrial) {
+  fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+  RT_CHECK(fd_ >= 0, "socket() failed");
+  int one = 1;
+  setsockopt(fd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  for (int p = port_start; p < port_start + ntrial; ++p) {
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(p));
+    if (::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+      RT_CHECK(::listen(fd_, 256) == 0, "listen failed");
+      port_ = p;
+      return;
+    }
+  }
+  Fail(StrFormat("no free port in [%d, %d)", port_start, port_start + ntrial));
+}
+
+TcpConn Listener::Accept() {
+  for (;;) {
+    int fd = ::accept(fd_, nullptr, nullptr);
+    if (fd >= 0) {
+      TcpConn c(fd);
+      c.SetNoDelay();
+      return c;
+    }
+    if (errno == EINTR) continue;
+    Fail(StrFormat("accept failed: %s", strerror(errno)));
+  }
+}
+
+void Listener::Close() {
+  if (fd_ >= 0) {
+    ::close(fd_);
+    fd_ = -1;
+  }
+}
+
+void Poller::WatchRead(int fd) { fds_.push_back({fd, POLLIN, 0}); }
+void Poller::WatchWrite(int fd) { fds_.push_back({fd, POLLOUT, 0}); }
+
+int Poller::Wait(int timeout_ms) {
+  for (;;) {
+    int rc = ::poll(fds_.data(), fds_.size(), timeout_ms);
+    if (rc >= 0 || errno != EINTR) return rc;
+  }
+}
+
+bool Poller::CanRead(int fd) const {
+  for (const auto& p : fds_)
+    if (p.fd == fd && (p.revents & (POLLIN | POLLHUP | POLLERR))) return true;
+  return false;
+}
+
+bool Poller::CanWrite(int fd) const {
+  for (const auto& p : fds_)
+    if (p.fd == fd && (p.revents & (POLLOUT | POLLHUP | POLLERR))) return true;
+  return false;
+}
+
+std::string GetHostName() {
+  char buf[256];
+  if (gethostname(buf, sizeof(buf)) != 0) return "localhost";
+  buf[sizeof(buf) - 1] = '\0';
+  return std::string(buf);
+}
+
+}  // namespace rt

@@ -1,0 +1,97 @@
+// TCP networking layer for the host-side control/data plane.
+// Capability parity with reference include/rabit/internal/socket.h
+// (SockAddr/TCPSocket/PollHelper, socket.h:50-533), redesigned: Linux-only
+// (no WinSock shims), RAII connections, explicit Result codes instead of
+// errno-taxonomy scattered through the engine (reference
+// allreduce_base.h:224-263), and progress-oriented TrySend/TryRecv used
+// by the poll-driven collectives.
+#ifndef RT_NET_H_
+#define RT_NET_H_
+
+#include <poll.h>
+
+#include <cstdint>
+#include <string>
+#include <vector>
+
+namespace rt {
+
+// Outcome of a socket operation; the recovery layer keys off kReset
+// (peer death) vs kError (local/socket failure) — reference
+// ReturnType {kSuccess,kConnReset,kRecvZeroLen,kSockError}.
+enum class NetResult { kOk, kAgain, kReset, kError };
+
+class TcpConn {
+ public:
+  TcpConn() = default;
+  explicit TcpConn(int fd) : fd_(fd) {}
+  TcpConn(const TcpConn&) = delete;
+  TcpConn& operator=(const TcpConn&) = delete;
+  TcpConn(TcpConn&& o) noexcept { *this = std::move(o); }
+  TcpConn& operator=(TcpConn&& o) noexcept;
+  ~TcpConn() { Close(); }
+
+  static TcpConn Connect(const std::string& host, int port,
+                         int retries = 30, int delay_ms = 200);
+
+  bool ok() const { return fd_ >= 0; }
+  int fd() const { return fd_; }
+  void Close();
+  void SetNonBlocking(bool on);
+  void SetNoDelay();
+  void SetKeepAlive();
+
+  // Blocking full-buffer ops (bootstrap/tracker path).
+  void SendAll(const void* data, size_t n);
+  void RecvAll(void* data, size_t n);
+  void SendU32(uint32_t v);
+  uint32_t RecvU32();
+  void SendStr(const std::string& s);   // u32 length prefix
+  std::string RecvStr();
+
+  // Progress ops for nonblocking collectives: move up to n bytes,
+  // return bytes moved, or -1 cast via NetResult out-param.
+  ssize_t TrySend(const void* data, size_t n, NetResult* res);
+  ssize_t TryRecv(void* data, size_t n, NetResult* res);
+
+ private:
+  int fd_ = -1;
+};
+
+// Listening socket with automatic port scan (reference TryBindHost,
+// allreduce_base.cc:306-324).
+class Listener {
+ public:
+  // binds the first free port in [port_start, port_start + ntrial)
+  void Bind(int port_start, int ntrial = 1000);
+  TcpConn Accept();
+  int port() const { return port_; }
+  int fd() const { return fd_; }
+  void Close();
+  ~Listener() { Close(); }
+
+ private:
+  int fd_ = -1;
+  int port_ = 0;
+};
+
+// poll(2) wrapper (reference PollHelper, socket.h:440-533).
+class Poller {
+ public:
+  void WatchRead(int fd);
+  void WatchWrite(int fd);
+  // returns number of ready fds; <0 on error; 0 on timeout
+  int Wait(int timeout_ms = -1);
+  bool CanRead(int fd) const;
+  bool CanWrite(int fd) const;
+  void Clear() { fds_.clear(); }
+
+ private:
+  std::vector<pollfd> fds_;
+};
+
+std::string GetHostName();
+
+}  // namespace rt
+
+#endif  // RT_NET_H_

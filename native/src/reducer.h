@@ -1,0 +1,110 @@
+// Elementwise reduction dispatch: (op enum x dtype enum) -> concrete
+// function. Capability parity with the reference's op functors
+// (rabit-inl.h:66-102) and the C-ABI double dispatch (c_api.cc:37-122),
+// including the BitOR-on-float rejection (c_api.cc:26-35). Wire enums
+// match the reference so the Python binding stays compatible.
+#ifndef RT_REDUCER_H_
+#define RT_REDUCER_H_
+
+#include <cstddef>
+#include <cstdint>
+
+#include "log.h"
+
+namespace rt {
+
+enum Op : int { kMax = 0, kMin = 1, kSum = 2, kBitOR = 3 };
+
+enum DType : int {
+  kInt8 = 0, kUInt8 = 1, kInt32 = 2, kUInt32 = 3,
+  kInt64 = 4, kUInt64 = 5, kFloat32 = 6, kFloat64 = 7,
+  // TPU-native extensions (Python side stages these through the XLA
+  // engine; host reduction treats f16/bf16 as unsupported for now)
+};
+
+inline size_t DTypeSize(int dtype) {
+  switch (dtype) {
+    case kInt8: case kUInt8: return 1;
+    case kInt32: case kUInt32: return 4;
+    case kInt64: case kUInt64: return 8;
+    case kFloat32: return 4;
+    case kFloat64: return 8;
+    default: Fail(StrFormat("unknown dtype enum %d", dtype));
+  }
+}
+
+// dst[i] = op(dst[i], src[i])
+typedef void (*ReduceFn)(void* dst, const void* src, size_t count);
+
+namespace detail {
+
+template <typename T> struct MaxOp {
+  static void Run(void* d, const void* s, size_t n) {
+    T* dst = static_cast<T*>(d);
+    const T* src = static_cast<const T*>(s);
+    for (size_t i = 0; i < n; ++i) if (src[i] > dst[i]) dst[i] = src[i];
+  }
+};
+template <typename T> struct MinOp {
+  static void Run(void* d, const void* s, size_t n) {
+    T* dst = static_cast<T*>(d);
+    const T* src = static_cast<const T*>(s);
+    for (size_t i = 0; i < n; ++i) if (src[i] < dst[i]) dst[i] = src[i];
+  }
+};
+template <typename T> struct SumOp {
+  static void Run(void* d, const void* s, size_t n) {
+    T* dst = static_cast<T*>(d);
+    const T* src = static_cast<const T*>(s);
+    for (size_t i = 0; i < n; ++i) dst[i] += src[i];
+  }
+};
+template <typename T> struct OrOp {
+  static void Run(void* d, const void* s, size_t n) {
+    T* dst = static_cast<T*>(d);
+    const T* src = static_cast<const T*>(s);
+    for (size_t i = 0; i < n; ++i) dst[i] |= src[i];
+  }
+};
+
+template <typename T>
+ReduceFn PickArith(int op) {
+  switch (op) {
+    case kMax: return MaxOp<T>::Run;
+    case kMin: return MinOp<T>::Run;
+    case kSum: return SumOp<T>::Run;
+    default: return nullptr;
+  }
+}
+
+template <typename T>
+ReduceFn PickInt(int op) {
+  if (op == kBitOR) return OrOp<T>::Run;
+  return PickArith<T>(op);
+}
+
+}  // namespace detail
+
+inline ReduceFn GetReducer(int op, int dtype) {
+  ReduceFn fn = nullptr;
+  switch (dtype) {
+    case kInt8:   fn = detail::PickInt<int8_t>(op); break;
+    case kUInt8:  fn = detail::PickInt<uint8_t>(op); break;
+    case kInt32:  fn = detail::PickInt<int32_t>(op); break;
+    case kUInt32: fn = detail::PickInt<uint32_t>(op); break;
+    case kInt64:  fn = detail::PickInt<int64_t>(op); break;
+    case kUInt64: fn = detail::PickInt<uint64_t>(op); break;
+    case kFloat32: fn = detail::PickArith<float>(op); break;   // no BitOR
+    case kFloat64: fn = detail::PickArith<double>(op); break;  // no BitOR
+    default: Fail(StrFormat("unknown dtype enum %d", dtype));
+  }
+  if (fn == nullptr) {
+    Fail(StrFormat("op %d not supported for dtype %d "
+                   "(BitOR on float rejected)", op, dtype));
+  }
+  return fn;
+}
+
+}  // namespace rt
+
+#endif  // RT_REDUCER_H_

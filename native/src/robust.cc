@@ -1,0 +1,505 @@
+#include "robust.h"
+
+#include <cstring>
+
+namespace rt {
+
+// ---------------------------------------------------------------------------
+// small helpers
+// ---------------------------------------------------------------------------
+
+static void ReduceAction(void* d, const void* s, size_t n) {
+  auto* dst = static_cast<RobustComm::ActionPod*>(d);
+  auto* src = static_cast<const RobustComm::ActionPod*>(s);
+  for (size_t i = 0; i < n; ++i) {
+    dst[i].flags |= src[i].flags;
+    if (src[i].seqno < dst[i].seqno) dst[i].seqno = src[i].seqno;
+    if (src[i].neg_seqno < dst[i].neg_seqno)
+      dst[i].neg_seqno = src[i].neg_seqno;
+  }
+}
+
+static void ReduceMaxU64(void* d, const void* s, size_t n) {
+  auto* dst = static_cast<uint64_t*>(d);
+  auto* src = static_cast<const uint64_t*>(s);
+  for (size_t i = 0; i < n; ++i)
+    if (src[i] > dst[i]) dst[i] = src[i];
+}
+
+static const uint32_t kRankBits = 20;  // world_size < 2^20
+static const uint32_t kRankMask = (1u << kRankBits) - 1;
+
+void RobustComm::Init(int argc, const char* const* argv) {
+  Comm::Init(argc, argv);
+  bootstrap_cache_enabled_ = cfg_.GetBool("rabit_bootstrap_cache", false);
+  num_local_replica_ =
+      static_cast<int>(cfg_.GetInt("rabit_local_replica", 2));
+}
+
+void RobustComm::Shutdown() {
+  // two-phase exit like the reference (allreduce_robust.cc:54-75): make
+  // sure nobody is mid-recovery needing us before links drop
+  Comm::Shutdown();
+}
+
+// elect max (key, rank): every rank contributes key<<20 | (mask - rank)
+std::pair<uint64_t, int> RobustComm::MaxKeyRank(uint64_t key) {
+  uint64_t word = (key << kRankBits) | (kRankMask - static_cast<uint32_t>(rank_));
+  ConsensusAllreduce(&word, sizeof(word), 1, ReduceMaxU64);
+  uint64_t k = word >> kRankBits;
+  int r = static_cast<int>(kRankMask - (word & kRankMask));
+  return {k, r};
+}
+
+void RobustComm::ConsensusAllreduce(void* buf, size_t elem_size, size_t count,
+                                    ReduceFn fn) {
+  std::string pristine(static_cast<char*>(buf), elem_size * count);
+  for (int attempt = 0; attempt < 1000; ++attempt) {
+    NetResult res = TryAllreduce(buf, elem_size, count, fn);
+    if (res == NetResult::kOk) return;
+    memcpy(buf, pristine.data(), pristine.size());
+    CheckAndRecover(res);
+  }
+  Fail("consensus allreduce failed after 1000 recovery attempts");
+}
+
+void RobustComm::CheckAndRecover(NetResult res) {
+  (void)res;
+  ++recover_counter_;
+  if (debug_) {
+    LogInfo(StrFormat("rank %d entering recovery #%d", rank_,
+                      recover_counter_));
+  }
+  ReconnectLinks("recover");
+}
+
+// ---------------------------------------------------------------------------
+// consensus rounds
+// ---------------------------------------------------------------------------
+
+bool RobustComm::RecoverExec(void* buf, size_t size, uint32_t flag,
+                             uint32_t my_seq, const std::string& cache_key) {
+  for (;;) {
+    ActionPod act;
+    act.flags = flag;
+    act.seqno = my_seq;
+    act.neg_seqno = ~my_seq;
+    ConsensusAllreduce(&act, sizeof(act), 1, ReduceAction);
+    uint32_t min_seq = act.seqno;
+    uint32_t max_seq = ~act.neg_seqno;
+    if (debug_) {
+      LogInfo(StrFormat("rank %d round: flags=%x min=%u max=%u "
+                        "(mine: flag=%x seq=%u ver=%d)",
+                        rank_, act.flags, min_seq, max_seq, flag, my_seq,
+                        version_));
+    }
+
+    if (act.flags & kLoadCheck) {
+      NetResult res = TryServeLoadCheckpoint();
+      if (res != NetResult::kOk) {
+        CheckAndRecover(res);
+        continue;
+      }
+      if (flag & kLoadCheck) return true;
+      continue;
+    }
+    if (act.flags & kLoadBootstrap) {
+      bool mine = (flag & kLoadBootstrap) != 0;
+      NetResult res = TryServeBootstrap(buf, size, mine, cache_key);
+      if (res != NetResult::kOk) {
+        CheckAndRecover(res);
+        continue;
+      }
+      if (mine) return true;
+      continue;
+    }
+    if (min_seq != max_seq) {
+      // someone lags: replay op min_seq from a holder to its requesters
+      bool i_am_requester = (my_seq == min_seq) && (flag == 0);
+      NetResult res = TryServeReplay(min_seq, buf, size, i_am_requester);
+      if (res != NetResult::kOk) {
+        CheckAndRecover(res);
+        continue;
+      }
+      if (i_am_requester) return true;
+      continue;
+    }
+    if (act.flags & kCheckPoint) {
+      if (flag & kCheckPoint) return false;  // everyone at the same fence
+      continue;
+    }
+    if (act.flags & kCheckAck) {
+      if (flag & kCheckAck) return false;
+      continue;
+    }
+    return false;  // uniform, nothing requested: execute the op fresh
+  }
+}
+
+NetResult RobustComm::TryServeLoadCheckpoint() {
+  // materialize a pending lazy checkpoint now that a failure needs it
+  // (reference allreduce_robust.cc:957-964)
+  if (lazy_global_ != nullptr) {
+    global_ckpt_ = *lazy_global_;
+    lazy_global_ = nullptr;
+  }
+  auto vr = MaxKeyRank(static_cast<uint64_t>(version_));
+  uint64_t max_version = vr.first;
+  int holder = vr.second;
+  if (max_version > 0) {
+    uint64_t len = global_ckpt_.size();
+    NetResult res = TryBroadcast(reinterpret_cast<char*>(&len), sizeof(len),
+                                 holder);
+    if (res != NetResult::kOk) return res;
+    std::string payload;
+    payload.resize(len);
+    if (rank_ == holder) payload = global_ckpt_;
+    if (len > 0) {
+      res = TryBroadcast(&payload[0], len, holder);
+      if (res != NetResult::kOk) return res;
+    }
+    if (static_cast<uint64_t>(version_) < max_version) {
+      global_ckpt_ = payload;
+      version_ = static_cast<int>(max_version);
+      seq_counter_ = 0;
+      result_log_.clear();
+    }
+  }
+  // local-checkpoint healing: for every rank, check need/have and route
+  // (reference TryRecoverLocalState, allreduce_robust.cc:1216-1347; ours
+  // is a per-rank elected-holder broadcast). EVERY rank participates in
+  // the per-rank elections unconditionally: gating on local config
+  // (e.g. num_local_replica_) would desync the protocol, because a
+  // freshly restarted rank and the survivors disagree on it until the
+  // votes below resolve the truth.
+  if (max_version > 0) {
+    for (int q = 0; q < world_; ++q) {
+      int dist = (rank_ - q + world_) % world_;  // q stored at q+1..q+R
+      bool have_q = false;
+      std::string* slot = nullptr;
+      if (q == rank_ && !local_ckpt_.empty()) {
+        have_q = true;
+        slot = &local_ckpt_;
+      } else if (dist >= 1 && dist <= num_local_replica_ &&
+                 static_cast<size_t>(dist - 1) < replica_local_.size() &&
+                 !replica_local_[dist - 1].empty()) {
+        have_q = true;
+        slot = &replica_local_[dist - 1];
+      }
+      bool need_q = (q == rank_) && local_ckpt_.empty() &&
+                    static_cast<uint64_t>(version_) == max_version &&
+                    local_expected_;
+      auto need_vote = MaxKeyRank(need_q ? 1 : 0);
+      if (need_vote.first == 0) continue;        // nobody needs q's local
+      auto have_vote = MaxKeyRank(have_q ? 1 : 0);
+      if (have_vote.first == 0) continue;        // nobody has it (lost)
+      int src = have_vote.second;
+      uint64_t len = slot ? slot->size() : 0;
+      NetResult res = TryBroadcast(reinterpret_cast<char*>(&len),
+                                   sizeof(len), src);
+      if (res != NetResult::kOk) return res;
+      std::string payload(len, '\0');
+      if (rank_ == src && slot) payload = *slot;
+      if (len > 0) {
+        res = TryBroadcast(&payload[0], len, src);
+        if (res != NetResult::kOk) return res;
+      }
+      if (need_q) local_ckpt_ = payload;
+    }
+  }
+  return NetResult::kOk;
+}
+
+NetResult RobustComm::TryServeReplay(uint32_t seq, void* buf, size_t size,
+                                     bool i_am_requester) {
+  bool have = result_log_.count(seq) != 0;
+  auto hv = MaxKeyRank(have ? 1 : 0);
+  RT_CHECK(hv.first == 1,
+           StrFormat("replay of op %u requested but no rank has it", seq));
+  int holder = hv.second;
+  const std::string* stored = have ? &result_log_[seq] : nullptr;
+  uint64_t len = (rank_ == holder) ? stored->size() : 0;
+  NetResult res = TryBroadcast(reinterpret_cast<char*>(&len), sizeof(len),
+                               holder);
+  if (res != NetResult::kOk) return res;
+  std::string payload(len, '\0');
+  if (rank_ == holder) payload = *stored;
+  if (len > 0) {
+    res = TryBroadcast(&payload[0], len, holder);
+    if (res != NetResult::kOk) return res;
+  }
+  if (i_am_requester) {
+    RT_CHECK(len == size,
+             StrFormat("replayed op %u size %llu != expected %zu", seq,
+                       static_cast<unsigned long long>(len), size));
+    memcpy(buf, payload.data(), size);
+  }
+  return NetResult::kOk;
+}
+
+NetResult RobustComm::TryServeBootstrap(void* buf, size_t size, bool mine,
+                                        const std::string& cache_key) {
+  // elect one requester per round, it broadcasts its key, then the
+  // elected holder broadcasts the cached value
+  auto rv = MaxKeyRank(mine ? 1 : 0);
+  RT_CHECK(rv.first == 1, "bootstrap round without requester");
+  int requester = rv.second;
+  bool lead = (rank_ == requester) && mine;
+  uint64_t klen = lead ? cache_key.size() : 0;
+  NetResult res = TryBroadcast(reinterpret_cast<char*>(&klen), sizeof(klen),
+                               requester);
+  if (res != NetResult::kOk) return res;
+  std::string key(klen, '\0');
+  if (lead) key = cache_key;
+  if (klen > 0) {
+    res = TryBroadcast(&key[0], klen, requester);
+    if (res != NetResult::kOk) return res;
+  }
+  bool have = bootstrap_cache_.count(key) != 0;
+  auto hv = MaxKeyRank(have ? 1 : 0);
+  RT_CHECK(hv.first == 1,
+           "bootstrap cache miss cluster-wide for key: " + key);
+  int holder = hv.second;
+  uint64_t len = (rank_ == holder) ? bootstrap_cache_[key].size() : 0;
+  res = TryBroadcast(reinterpret_cast<char*>(&len), sizeof(len), holder);
+  if (res != NetResult::kOk) return res;
+  std::string payload(len, '\0');
+  if (rank_ == holder) payload = bootstrap_cache_[key];
+  if (len > 0) {
+    res = TryBroadcast(&payload[0], len, holder);
+    if (res != NetResult::kOk) return res;
+  }
+  if (lead) {
+    RT_CHECK(len == size, "bootstrap replay size mismatch for " + key);
+    memcpy(buf, payload.data(), size);
+  }
+  return NetResult::kOk;
+}
+
+// ---------------------------------------------------------------------------
+// public collectives with recovery
+// ---------------------------------------------------------------------------
+
+void RobustComm::Allreduce(void* buf, size_t elem_size, size_t count,
+                           ReduceFn reducer, PrepareFn prepare,
+                           void* prepare_arg, const char* cache_key) {
+  OnEngineCall("allreduce");
+  const size_t size = elem_size * count;
+  if (world_ == 1) {
+    if (prepare) prepare(prepare_arg);
+    return;
+  }
+  std::string key = cache_key ? cache_key : "";
+  // pre-LoadCheckpoint collectives go through the bootstrap cache and
+  // consume NO sequence numbers (reference allreduce_robust.cc:174-180,
+  // 212-218: results land in the signature-keyed cache instead of the
+  // seq-indexed result buffer, so post-load numbering aligns across
+  // fresh and restarted workers)
+  const bool bootstrap_op =
+      bootstrap_cache_enabled_ && before_first_load_ && !key.empty();
+  if (bootstrap_op) {
+    auto it = bootstrap_cache_.find(key);
+    if (it != bootstrap_cache_.end()) {
+      RT_CHECK(it->second.size() == size, "bootstrap cache size mismatch");
+      memcpy(buf, it->second.data(), size);
+      return;
+    }
+    if (num_attempt_ > 0) {
+      // restarted before first load: fetch this op from a holder
+      bool served = RecoverExec(buf, size, kLoadBootstrap, seq_counter_,
+                                key);
+      RT_CHECK(served, "bootstrap fetch round did not serve requester");
+      FinishOp(buf, size, key, /*bootstrap=*/true);
+      return;
+    }
+  }
+  if (RecoverExec(buf, size, 0, seq_counter_, key)) {
+    FinishOp(buf, size, key, bootstrap_op);
+    return;
+  }
+  if (prepare) prepare(prepare_arg);
+  std::string pristine(static_cast<char*>(buf), size);
+  for (;;) {
+    NetResult res = TryAllreduce(buf, elem_size, count, reducer);
+    if (res == NetResult::kOk) {
+      FinishOp(buf, size, key, bootstrap_op);
+      return;
+    }
+    CheckAndRecover(res);
+    memcpy(buf, pristine.data(), size);
+    if (RecoverExec(buf, size, 0, seq_counter_, key)) {
+      FinishOp(buf, size, key, bootstrap_op);
+      return;
+    }
+    memcpy(buf, pristine.data(), size);
+  }
+}
+
+void RobustComm::Broadcast(void* buf, size_t size, int root,
+                           const char* cache_key) {
+  OnEngineCall("broadcast");
+  if (world_ == 1) return;
+  std::string key = cache_key ? cache_key : "";
+  const bool bootstrap_op =
+      bootstrap_cache_enabled_ && before_first_load_ && !key.empty();
+  if (bootstrap_op) {
+    auto it = bootstrap_cache_.find(key);
+    if (it != bootstrap_cache_.end()) {
+      RT_CHECK(it->second.size() == size, "bootstrap cache size mismatch");
+      memcpy(buf, it->second.data(), size);
+      return;
+    }
+    if (num_attempt_ > 0) {
+      bool served = RecoverExec(buf, size, kLoadBootstrap, seq_counter_,
+                                key);
+      RT_CHECK(served, "bootstrap fetch round did not serve requester");
+      FinishOp(buf, size, key, /*bootstrap=*/true);
+      return;
+    }
+  }
+  if (RecoverExec(buf, size, 0, seq_counter_, key)) {
+    FinishOp(buf, size, key, bootstrap_op);
+    return;
+  }
+  std::string pristine(static_cast<char*>(buf), size);
+  for (;;) {
+    NetResult res = TryBroadcast(static_cast<char*>(buf), size, root);
+    if (res == NetResult::kOk) {
+      FinishOp(buf, size, key, bootstrap_op);
+      return;
+    }
+    CheckAndRecover(res);
+    memcpy(buf, pristine.data(), size);
+    if (RecoverExec(buf, size, 0, seq_counter_, key)) {
+      FinishOp(buf, size, key, bootstrap_op);
+      return;
+    }
+    memcpy(buf, pristine.data(), size);
+  }
+}
+
+void RobustComm::FinishOp(const void* buf, size_t size,
+                          const std::string& key, bool bootstrap) {
+  if (bootstrap) {
+    // pre-load ops: signature-keyed cache only, no seq consumption
+    bootstrap_cache_[key] =
+        std::string(static_cast<const char*>(buf), size);
+    return;
+  }
+  result_log_[seq_counter_] =
+      std::string(static_cast<const char*>(buf), size);
+  ++seq_counter_;
+}
+
+// ---------------------------------------------------------------------------
+// checkpointing
+// ---------------------------------------------------------------------------
+
+int RobustComm::LoadCheckpoint(std::string* global, std::string* local) {
+  OnEngineCall("load_checkpoint");
+  if (world_ == 1) {
+    if (lazy_global_ != nullptr) {
+      global_ckpt_ = *lazy_global_;
+      lazy_global_ = nullptr;
+    }
+    if (global) *global = global_ckpt_;
+    if (local) *local = local_ckpt_;
+    before_first_load_ = false;
+    return version_;
+  }
+  local_expected_ = (local != nullptr);
+  bool served = RecoverExec(nullptr, 0, kLoadCheck, seq_counter_);
+  RT_CHECK(served, "load-checkpoint round did not serve the requester");
+  if (global) *global = global_ckpt_;
+  if (local) *local = local_ckpt_;
+  before_first_load_ = false;
+  // No ack barrier here: the load is served atomically inside its
+  // consensus round, and a barrier flag would wedge the diff-seq replay
+  // protocol (a caught-up restarter holds the flag at seq 0 while alive
+  // ranks are mid-iteration, and flagged ranks are not replay
+  // requesters). The restarter catches up through replay rounds next.
+  return version_;
+}
+
+void RobustComm::Checkpoint(const std::string& global,
+                            const std::string& local) {
+  OnEngineCall("checkpoint");
+  if (world_ == 1) {
+    global_ckpt_ = global;
+    local_ckpt_ = local;
+    lazy_global_ = nullptr;
+    ++version_;
+    return;
+  }
+  // lock in with/without-local mode on first checkpoint (reference
+  // LocalModelCheck, allreduce_robust.cc:371-387)
+  if (!local_mode_decided_) {
+    local_mode_decided_ = true;
+    local_expected_ = !local.empty();
+    if (!local_expected_) num_local_replica_ = 0;
+    if (num_local_replica_ > world_ - 1) num_local_replica_ = world_ - 1;
+  }
+  // phase 1: everyone reaches the checkpoint fence (returns false when
+  // the whole world is at it)
+  RecoverExec(nullptr, 0, kCheckPoint, seq_counter_);
+  // local replication along the ring
+  if (!local.empty() && num_local_replica_ > 0) {
+    local_ckpt_ = local;
+    for (;;) {
+      NetResult res = TryReplicateLocal();
+      if (res == NetResult::kOk) break;
+      CheckAndRecover(res);
+    }
+  } else {
+    local_ckpt_ = local;
+  }
+  // commit
+  global_ckpt_ = global;
+  lazy_global_ = nullptr;
+  ++version_;
+  result_log_.clear();
+  seq_counter_ = 0;
+  // phase 2: nobody proceeds until everyone committed (reference
+  // two-phase kCheckPoint/kCheckAck, allreduce_robust.cc:436-464)
+  RecoverExec(nullptr, 0, kCheckAck, seq_counter_);
+}
+
+void RobustComm::LazyCheckpoint(const std::string* global) {
+  OnEngineCall("checkpoint");
+  if (world_ == 1) {
+    lazy_global_ = global;
+    ++version_;
+    return;
+  }
+  RecoverExec(nullptr, 0, kCheckPoint, seq_counter_);
+  lazy_global_ = global;  // serialization deferred until a failure
+  ++version_;
+  result_log_.clear();
+  seq_counter_ = 0;
+  RecoverExec(nullptr, 0, kCheckAck, seq_counter_);
+}
+
+// pass my local checkpoint to the next num_local_replica_ ring successors
+// (reference TryCheckinLocalState + RingPassing,
+// allreduce_robust.cc:1363-1475)
+NetResult RobustComm::TryReplicateLocal() {
+  replica_local_.assign(static_cast<size_t>(num_local_replica_), "");
+  std::string outgoing = local_ckpt_;
+  for (int hop = 0; hop < num_local_replica_; ++hop) {
+    uint64_t send_len = outgoing.size();
+    uint64_t recv_len = 0;
+    NetResult res = RingExchange(
+        reinterpret_cast<const char*>(&send_len), sizeof(send_len),
+        reinterpret_cast<char*>(&recv_len), sizeof(recv_len));
+    if (res != NetResult::kOk) return res;
+    std::string incoming(recv_len, '\0');
+    res = RingExchange(outgoing.data(), outgoing.size(),
+                       recv_len ? &incoming[0] : nullptr, recv_len);
+    if (res != NetResult::kOk) return res;
+    replica_local_[hop] = incoming;  // local state of rank (r-1-hop)
+    outgoing = incoming;             // forward it another hop
+  }
+  return NetResult::kOk;
+}
+
+}  // namespace rt

@@ -1,0 +1,65 @@
+// In-memory byte streams — the serialization substrate for checkpoints.
+// Capability parity with reference include/rabit/internal/io.h
+// (MemoryFixSizeBuffer / MemoryBufferStream over dmlc::SeekStream), but
+// designed around std::string buffers with explicit cursors since the
+// dmlc-core dependency is not part of this project.
+#ifndef RT_STREAM_H_
+#define RT_STREAM_H_
+
+#include <cstring>
+#include <string>
+
+#include "log.h"
+
+namespace rt {
+
+// Growable in-memory stream (reference MemoryBufferStream, io.h:60-103).
+class MemStream {
+ public:
+  MemStream() = default;
+  explicit MemStream(std::string data) : buf_(std::move(data)) {}
+
+  void Write(const void* ptr, size_t n) {
+    if (pos_ + n > buf_.size()) buf_.resize(pos_ + n);
+    memcpy(&buf_[pos_], ptr, n);
+    pos_ += n;
+  }
+  size_t Read(void* ptr, size_t n) {
+    size_t avail = buf_.size() - pos_;
+    if (n > avail) n = avail;
+    memcpy(ptr, &buf_[pos_], n);
+    pos_ += n;
+    return n;
+  }
+  template <typename T>
+  void WritePod(const T& v) { Write(&v, sizeof(T)); }
+  template <typename T>
+  T ReadPod() {
+    T v{};
+    RT_CHECK(Read(&v, sizeof(T)) == sizeof(T), "stream underrun");
+    return v;
+  }
+  void WriteStr(const std::string& s) {
+    WritePod<uint64_t>(s.size());
+    Write(s.data(), s.size());
+  }
+  std::string ReadStr() {
+    uint64_t n = ReadPod<uint64_t>();
+    RT_CHECK(pos_ + n <= buf_.size(), "stream underrun");
+    std::string s = buf_.substr(pos_, n);
+    pos_ += n;
+    return s;
+  }
+  void Seek(size_t pos) { pos_ = pos; }
+  size_t Tell() const { return pos_; }
+  const std::string& Buffer() const { return buf_; }
+  std::string&& TakeBuffer() { return std::move(buf_); }
+
+ private:
+  std::string buf_;
+  size_t pos_ = 0;
+};
+
+}  // namespace rt
+
+#endif  // RT_STREAM_H_

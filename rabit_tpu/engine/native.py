@@ -1,0 +1,226 @@
+"""ctypes binding to the C++ native engines (librabit_tpu_core.so).
+
+Mirrors the reference Python binding's loader + call conventions
+(python/rabit.py:20-74 loader, :209-263 allreduce trampoline) against
+our C ABI (native/include/rabit_tpu_c.h). Engine variant (base / robust
+/ mock) is selected at runtime via the ``rabit_engine`` parameter —
+the reference selects at link time between librabit/_base/_mock.
+
+Caller-signature cache keys: the reference captures __builtin_FILE/LINE
+in its C++ templates (rabit.h:26-39) so the bootstrap cache can replay
+pre-LoadCheckPoint collectives; through its C ABI those keys are lost.
+Ours reconstructs them from the Python caller frame and passes them via
+RbtAllreduceEx, keeping replay working through the binding.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import inspect
+import os
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from .base import Engine
+from ..ops.reducers import DTYPE_ENUM
+
+_LIB_ENV = "RABIT_TPU_CORE_LIB"
+
+
+def _find_library() -> str:
+    cands = []
+    env = os.environ.get(_LIB_ENV)
+    if env:
+        cands.append(env)
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(os.path.dirname(here))
+    cands += [
+        os.path.join(root, "native", "build", "librabit_tpu_core.so"),
+        os.path.join(root, "librabit_tpu_core.so"),
+    ]
+    for c in cands:
+        if os.path.isfile(c):
+            return c
+    raise ImportError(
+        "librabit_tpu_core.so not found; build it with\n"
+        "  cmake -S native -B native/build -G Ninja && "
+        "ninja -C native/build\n"
+        f"searched: {cands}")
+
+
+_PREPARE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+
+
+def _load() -> ctypes.CDLL:
+    lib = ctypes.cdll.LoadLibrary(_find_library())
+    lib.RbtInit.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_char_p)]
+    lib.RbtGetRank.restype = ctypes.c_int
+    lib.RbtGetWorldSize.restype = ctypes.c_int
+    lib.RbtIsDistributed.restype = ctypes.c_int
+    lib.RbtVersionNumber.restype = ctypes.c_int
+    lib.RbtGetLastError.restype = ctypes.c_char_p
+    lib.RbtAllreduceEx.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+        _PREPARE_CB, ctypes.c_void_p, ctypes.c_char_p]
+    lib.RbtBroadcast.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int]
+    lib.RbtBroadcastEx.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int, ctypes.c_char_p]
+    lib.RbtCheckpoint.argtypes = [
+        ctypes.c_char_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64]
+    lib.RbtLazyCheckpoint.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+    lib.RbtLoadCheckpoint.argtypes = [
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.RbtLoadCheckpoint.restype = ctypes.c_int
+    return lib
+
+
+def _caller_site(depth: int = 3) -> str:
+    """file::line caller signature (reference rabit.h:26-39 semantics)."""
+    try:
+        frame = inspect.stack()[depth]
+        return f"{os.path.basename(frame.filename)}::{frame.lineno}"
+    except Exception:  # pragma: no cover
+        return ""
+
+
+class NativeEngine(Engine):
+    def __init__(self, variant: str = "robust") -> None:
+        self._lib = _load()
+        self._variant = variant
+        self._key_counts: dict = {}
+
+    def _cache_key(self, site: str, size: int) -> bytes:
+        """Deterministic replay key: caller site + payload size + an
+        occurrence counter, so repeated same-site pre-load calls get
+        distinct keys that are stable across process restarts (the
+        reference keys on file::line::caller#nbytes, rabit.h:26-39)."""
+        if not site:
+            return b""
+        base = f"{site}#{size}"
+        n = self._key_counts.get(base, 0)
+        self._key_counts[base] = n + 1
+        return f"{base}@{n}".encode()
+
+    def _check(self, rc: int, what: str) -> None:
+        if rc != 0:
+            err = self._lib.RbtGetLastError().decode()
+            raise RuntimeError(f"native {what} failed: {err}")
+
+    def init(self, args: List[str]) -> None:
+        argv = list(args)
+        if self._variant != "auto" and \
+                not any(a.startswith("rabit_engine=") for a in argv):
+            argv.append(f"rabit_engine={self._variant}")
+        arr = (ctypes.c_char_p * len(argv))(*[a.encode() for a in argv])
+        self._check(self._lib.RbtInit(len(argv), arr), "init")
+
+    def shutdown(self) -> None:
+        self._check(self._lib.RbtFinalize(), "finalize")
+
+    def allreduce(self, buf: np.ndarray, op: int,
+                  prepare_fun: Optional[Callable[[], None]] = None,
+                  key: str = "") -> None:
+        assert buf.flags["C_CONTIGUOUS"]
+        dtype_enum = DTYPE_ENUM[np.dtype(buf.dtype)]
+        cache_key = key.encode() if key else \
+            self._cache_key(_caller_site(), buf.nbytes)
+        if prepare_fun is None:
+            cb = _PREPARE_CB()
+        else:
+            def trampoline(_arg, fn=prepare_fun):
+                fn()
+            cb = _PREPARE_CB(trampoline)
+        rc = self._lib.RbtAllreduceEx(
+            buf.ctypes.data_as(ctypes.c_void_p), buf.size, dtype_enum, op,
+            cb, None, cache_key)
+        self._check(rc, "allreduce")
+
+    def broadcast(self, data: Optional[bytes], root: int) -> bytes:
+        # two-phase: 8-byte length then payload (reference rabit.py:171-206)
+        site = _caller_site()
+        length = np.zeros(1, dtype=np.uint64)
+        if self.rank == root:
+            if data is None:
+                raise ValueError("root must provide broadcast data")
+            length[0] = len(data)
+        rc = self._lib.RbtBroadcastEx(
+            length.ctypes.data_as(ctypes.c_void_p), 8, root,
+            self._cache_key(site + "/len", 8))
+        self._check(rc, "broadcast(size)")
+        n = int(length[0])
+        payload = ctypes.create_string_buffer(n)
+        if self.rank == root and n:
+            payload.raw = data
+        if n:
+            rc = self._lib.RbtBroadcastEx(
+                ctypes.cast(payload, ctypes.c_void_p), n, root,
+                self._cache_key(site + "/payload", n))
+            self._check(rc, "broadcast(payload)")
+        return payload.raw[:n]
+
+    def load_checkpoint(self, with_local: bool = False
+                        ) -> Tuple[int, Optional[bytes], Optional[bytes]]:
+        gptr = ctypes.POINTER(ctypes.c_char)()
+        glen = ctypes.c_uint64()
+        if with_local:
+            lptr = ctypes.POINTER(ctypes.c_char)()
+            llen = ctypes.c_uint64()
+            version = self._lib.RbtLoadCheckpoint(
+                ctypes.byref(gptr), ctypes.byref(glen),
+                ctypes.byref(lptr), ctypes.byref(llen))
+        else:
+            lptr = llen = None
+            version = self._lib.RbtLoadCheckpoint(
+                ctypes.byref(gptr), ctypes.byref(glen), None, None)
+        if version < 0:
+            self._check(-1, "load_checkpoint")
+        gbytes = bytes(gptr[:glen.value]) if version > 0 else None
+        lbytes = None
+        if with_local and version > 0 and llen.value:
+            lbytes = bytes(lptr[:llen.value])
+        return (version, gbytes, lbytes)
+
+    def checkpoint(self, global_bytes: bytes,
+                   local_bytes: Optional[bytes] = None) -> None:
+        rc = self._lib.RbtCheckpoint(
+            global_bytes, len(global_bytes),
+            local_bytes, 0 if local_bytes is None else len(local_bytes))
+        self._check(rc, "checkpoint")
+
+    def lazy_checkpoint(self, make_global: Callable[[], bytes]) -> None:
+        payload = make_global()  # Python can't defer across the ABI safely
+        rc = self._lib.RbtLazyCheckpoint(payload, len(payload))
+        self._check(rc, "lazy_checkpoint")
+
+    def tracker_print(self, msg: str) -> None:
+        self._check(self._lib.RbtTrackerPrint(msg.encode()), "tracker_print")
+
+    @property
+    def rank(self) -> int:
+        r = self._lib.RbtGetRank()
+        if r < 0:
+            self._check(-1, "get_rank")
+        return r
+
+    @property
+    def world_size(self) -> int:
+        w = self._lib.RbtGetWorldSize()
+        if w < 0:
+            self._check(-1, "get_world_size")
+        return w
+
+    @property
+    def is_distributed(self) -> bool:
+        return bool(self._lib.RbtIsDistributed())
+
+    @property
+    def version_number(self) -> int:
+        v = self._lib.RbtVersionNumber()
+        if v < 0:
+            self._check(-1, "version_number")
+        return v

@@ -100,8 +100,9 @@ class XlaEngine(Engine):
         method = "ring" if n >= self._ring_mincount else "tree"
         mesh = self._mesh
         # 64-bit payloads: without x64, device_put silently truncates
-        # int64/float64 to 32 bits; scope-enable it for this reduction.
-        ctx = jax.experimental.enable_x64() if buf.dtype.itemsize == 8 \
+        # int64/float64 to 32 bits; scope-enable it for this reduction
+        # (jax.enable_x64 is the >=0.9 context manager).
+        ctx = jax.enable_x64(True) if buf.dtype.itemsize == 8 \
             else contextlib.nullcontext()
         with ctx:
             sharding = NamedSharding(mesh, P("proc"))

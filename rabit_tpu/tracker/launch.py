@@ -1,0 +1,95 @@
+"""Local cluster launcher — the ``dmlc-submit --cluster local
+--num-workers N --local-num-attempt M`` equivalent (reference
+test/test.mk:13-37): starts a tracker, spawns N worker processes, and
+respawns any worker that dies (up to ``max_attempts`` times per worker,
+with the attempt counter exported so mock kill schedules advance).
+
+Usage:
+    python -m rabit_tpu.tracker.launch -n 4 [--max-attempts 20] \
+        prog arg1 key=value ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from .tracker import Tracker
+
+
+def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
+           timeout: float = 300.0, quiet: bool = False) -> int:
+    """Run ``cmd`` as ``nworkers`` local processes under a tracker.
+    Returns 0 on success. Workers exiting nonzero are respawned with an
+    incremented attempt counter until ``max_attempts``."""
+    tracker = Tracker(nworkers).start()
+    procs: Dict[int, subprocess.Popen] = {}
+    attempts: Dict[int, int] = {i: 0 for i in range(nworkers)}
+    finished: Dict[int, bool] = {i: False for i in range(nworkers)}
+
+    def spawn(i: int) -> None:
+        env = dict(os.environ)
+        env.update(tracker.env(task_id=str(i), num_attempt=attempts[i]))
+        procs[i] = subprocess.Popen(cmd, env=env)
+
+    try:
+        for i in range(nworkers):
+            spawn(i)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            alive = False
+            for i in range(nworkers):
+                p = procs.get(i)
+                if p is None or finished[i]:
+                    continue
+                rc = p.poll()
+                if rc is None:
+                    alive = True
+                    continue
+                if rc == 0:
+                    finished[i] = True
+                    continue
+                attempts[i] += 1
+                if attempts[i] > max_attempts:
+                    raise RuntimeError(
+                        f"worker {i} failed rc={rc} after "
+                        f"{max_attempts} attempts")
+                if not quiet:
+                    print(f"[launch] worker {i} died rc={rc}; respawn "
+                          f"attempt {attempts[i]}", file=sys.stderr,
+                          flush=True)
+                spawn(i)
+                alive = True
+            if all(finished.values()):
+                return 0
+            if not alive:
+                break
+            time.sleep(0.05)
+        raise RuntimeError(
+            f"timeout/stall: finished={sum(finished.values())}/{nworkers}")
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+        tracker.stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--max-attempts", type=int, default=20)
+    ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if not args.cmd:
+        ap.error("missing worker command")
+    return launch(args.num_workers, args.cmd, args.max_attempts,
+                  args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,231 @@
+"""Rendezvous tracker.
+
+The reference outsources this to dmlc-core's tracker (invoked as
+``dmlc-submit``, test/test.mk:16); only the worker-side protocol lives in
+its repo (allreduce_base.cc:222-441). This is our own tracker: it assigns
+stable ranks (task_id -> rank survives restarts, the basis of
+fail-restart-and-catch-up recovery), computes the tree + ring topology,
+barriers each (re)registration epoch so every worker is listening before
+link wiring starts, and relays ``print``/``shutdown`` commands.
+
+Wire protocol (binary, little-endian, length-prefixed strings):
+  worker -> tracker: magic u32 0x52425401, cmd str, task_id str,
+                     num_attempt u32
+    start/recover: + host str, listen_port u32
+    print:         + msg str
+  tracker -> worker (start/recover): rank u32, world u32, parent u32
+    (0xFFFFFFFF = none), ntree u32 + tree neighbor ranks, ring_prev u32,
+    ring_next u32, nconnect u32 + (peer_rank u32, host str, port u32)...,
+    naccept u32; worker replies ready u32 after wiring its links.
+Workers connect to lower-ranked neighbors and accept from higher ranks.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Tuple
+
+MAGIC = 0x52425401
+NO_RANK = 0xFFFFFFFF
+
+
+def _recv_all(conn: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = conn.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("worker closed connection")
+        out += chunk
+    return out
+
+
+def _recv_u32(conn) -> int:
+    return struct.unpack("<I", _recv_all(conn, 4))[0]
+
+
+def _send_u32(conn, v: int) -> None:
+    conn.sendall(struct.pack("<I", v))
+
+
+def _recv_str(conn) -> str:
+    n = _recv_u32(conn)
+    return _recv_all(conn, n).decode()
+
+
+def _send_str(conn, s: str) -> None:
+    b = s.encode()
+    _send_u32(conn, len(b))
+    conn.sendall(b)
+
+
+def tree_neighbors(rank: int, world: int) -> Tuple[Optional[int], List[int]]:
+    """Complete binary tree: parent + children of ``rank``."""
+    parent = (rank - 1) // 2 if rank > 0 else None
+    children = [c for c in (2 * rank + 1, 2 * rank + 2) if c < world]
+    return parent, children
+
+
+class Tracker:
+    def __init__(self, nworkers: int, host: str = "127.0.0.1", port: int = 0):
+        self.nworkers = nworkers
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind((host, port))
+        self.sock.listen(256)
+        self.host, self.port = self.sock.getsockname()
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._ranks: Dict[str, int] = {}        # task_id -> stable rank
+        self._pending: Dict[int, Tuple[socket.socket, str, int]] = {}
+        self._epoch = 0
+        self._shutdown_ranks: set = set()
+        self._done = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.messages: List[str] = []
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "Tracker":
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return self
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+    def stop(self) -> None:
+        self._done.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def env(self, task_id: str, num_attempt: int = 0) -> Dict[str, str]:
+        """Environment for a worker process."""
+        return {
+            "RABIT_TRACKER_URI": self.host,
+            "RABIT_TRACKER_PORT": str(self.port),
+            "RABIT_TASK_ID": task_id,
+            "RABIT_NUM_TRIAL": str(num_attempt),
+            "RABIT_WORLD_SIZE": str(self.nworkers),
+        }
+
+    # -- serving ----------------------------------------------------------
+    def _serve(self) -> None:
+        self.sock.settimeout(0.2)
+        while not self._done.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._handle, args=(conn,),
+                                 daemon=True)
+            t.start()
+
+    def _handle(self, conn: socket.socket) -> None:
+        try:
+            magic = _recv_u32(conn)
+            if magic != MAGIC:
+                conn.close()
+                return
+            cmd = _recv_str(conn)
+            task_id = _recv_str(conn)
+            _recv_u32(conn)  # num_attempt (informational)
+            if cmd == "print":
+                msg = _recv_str(conn)
+                self.messages.append(msg)
+                print(msg, flush=True)
+                _send_u32(conn, 1)
+                conn.close()
+            elif cmd == "shutdown":
+                with self._lock:
+                    rank = self._ranks.get(task_id)
+                    if rank is not None:
+                        self._shutdown_ranks.add(rank)
+                    all_down = len(self._shutdown_ranks) >= self.nworkers
+                _send_u32(conn, 1)
+                conn.close()
+                if all_down:
+                    self._done.set()
+            elif cmd in ("start", "recover"):
+                host = _recv_str(conn)
+                port = _recv_u32(conn)
+                self._register(conn, task_id, host, port)
+            else:
+                conn.close()
+        except (ConnectionError, OSError, struct.error):
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _register(self, conn, task_id: str, host: str, port: int) -> None:
+        with self._cv:
+            if task_id not in self._ranks:
+                self._ranks[task_id] = len(self._ranks)
+            rank = self._ranks[task_id]
+            if rank >= self.nworkers:
+                conn.close()
+                return
+            self._shutdown_ranks.discard(rank)
+            self._pending[rank] = (conn, host, port)
+            if len(self._pending) == self.nworkers:
+                batch = dict(self._pending)
+                self._pending.clear()
+                self._epoch += 1
+                self._cv.notify_all()
+                # assignment happens outside the lock in this thread
+            else:
+                self._cv.wait_for(
+                    lambda: rank not in self._pending or self._done.is_set())
+                return  # the completing thread serves everyone
+        self._assign(batch)
+
+    def _assign(self, batch: Dict[int, Tuple[socket.socket, str, int]]
+                ) -> None:
+        world = self.nworkers
+        addr = {r: (h, p) for r, (c, h, p) in batch.items()}
+        conns = {r: c for r, (c, h, p) in batch.items()}
+        for rank in sorted(batch):
+            conn = conns[rank]
+            parent, children = tree_neighbors(rank, world)
+            tree_nbrs = ([] if parent is None else [parent]) + children
+            ring_prev = (rank - 1) % world
+            ring_next = (rank + 1) % world
+            neighbors = sorted(set(tree_nbrs) |
+                               ({ring_prev, ring_next} if world > 1
+                                else set()))
+            connect_to = [r for r in neighbors if r < rank]
+            naccept = len([r for r in neighbors if r > rank])
+            try:
+                _send_u32(conn, rank)
+                _send_u32(conn, world)
+                _send_u32(conn, NO_RANK if parent is None else parent)
+                _send_u32(conn, len(tree_nbrs))
+                for r in tree_nbrs:
+                    _send_u32(conn, r)
+                _send_u32(conn, ring_prev)
+                _send_u32(conn, ring_next)
+                _send_u32(conn, len(connect_to))
+                for r in connect_to:
+                    _send_u32(conn, r)
+                    _send_str(conn, addr[r][0])
+                    _send_u32(conn, addr[r][1])
+                _send_u32(conn, naccept)
+            except OSError:
+                pass
+        # ready acks (worker finished wiring)
+        for rank, conn in conns.items():
+            try:
+                conn.settimeout(60)
+                _recv_u32(conn)
+            except (OSError, ConnectionError, struct.error):
+                pass
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass

@@ -1,0 +1,49 @@
+"""Multi-process integration tests: C++ engines through the tracker
+(the reference's tier-2 test strategy — N local processes under
+dmlc-submit, test/test.mk:13-37 — with our own tracker/launcher)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "native", "build", "librabit_tpu_core.so")
+WORKERS = os.path.join(ROOT, "tests", "workers")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isfile(LIB),
+    reason="native core not built (cmake -S native -B native/build)")
+
+sys.path.insert(0, ROOT)
+
+
+def run_cluster(nworkers, worker, extra_args=(), env=None, timeout=120,
+                max_attempts=20):
+    from rabit_tpu.tracker.launch import launch
+    cmd = [sys.executable, os.path.join(WORKERS, worker)] + list(extra_args)
+    old = {}
+    if env:
+        for k, v in env.items():
+            old[k] = os.environ.get(k)
+            os.environ[k] = v
+    try:
+        return launch(nworkers, cmd, max_attempts=max_attempts,
+                      timeout=timeout)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@pytest.mark.parametrize("nworkers", [2, 3, 5])
+def test_basic_collectives(nworkers):
+    assert run_cluster(nworkers, "basic_worker.py") == 0
+
+
+def test_basic_collectives_robust_engine():
+    assert run_cluster(4, "basic_worker.py",
+                       env={"WORKER_ENGINE": "robust"}) == 0

@@ -1,0 +1,72 @@
+"""Fault-injection recovery tests — the reference's signature capability
+(test/test.mk:13-37 scenarios: die at first checkpoint, multiple
+simultaneous deaths, repeated death of the same rank / die_hard)."""
+
+import os
+
+import pytest
+
+from tests.test_integration import run_cluster, LIB
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isfile(LIB), reason="native core not built")
+
+
+def test_no_failure_checkpoint_loop():
+    # sanity: checkpoint loop with the robust engine, nobody dies
+    assert run_cluster(4, "recover_worker.py") == 0
+
+
+def test_single_death_at_first_iteration():
+    # rank 0 dies at version 0, seq 0 (first collective), trial 0
+    assert run_cluster(4, "recover_worker.py",
+                       extra_args=["mock=0,0,0,0"]) == 0
+
+
+def test_single_death_mid_training():
+    # rank 1 dies at version 2, mid-iteration (seq 1), trial 0
+    assert run_cluster(4, "recover_worker.py",
+                       extra_args=["mock=1,2,1,0"]) == 0
+
+
+def test_multiple_simultaneous_deaths():
+    # ranks 0 and 2 both die at version 1 (reference test.mk:20-21)
+    assert run_cluster(4, "recover_worker.py",
+                       extra_args=["mock=0,1,0,0", "mock=2,1,1,0"]) == 0
+
+
+def test_die_hard_same_rank_twice():
+    # rank 1 dies at v1s1 trial 0, then again at v1s1 trial 1
+    # (reference die_hard, test.mk:22-23)
+    assert run_cluster(4, "recover_worker.py",
+                       extra_args=["mock=1,1,1,0", "mock=1,1,1,1"]) == 0
+
+
+def test_death_at_load_checkpoint():
+    # rank 3 dies at its very first engine call after restart too
+    assert run_cluster(4, "recover_worker.py",
+                       extra_args=["mock=3,0,0,0", "mock=3,0,0,1"]) == 0
+
+
+def test_local_checkpoint_recovery():
+    # local model ring-replicated and recovered (reference
+    # local_recover.cc)
+    assert run_cluster(4, "recover_worker.py",
+                       extra_args=["mock=2,2,0,0"],
+                       env={"WITH_LOCAL": "1"}) == 0
+
+
+def test_bootstrap_cache_recovery():
+    # pre-LoadCheckpoint collectives replayed for a restarted worker via
+    # the signature-keyed bootstrap cache (reference
+    # allreduce_robust.cc:89-141)
+    assert run_cluster(4, "bootstrap_worker.py",
+                       extra_args=["rabit_bootstrap_cache=1",
+                                   "mock=2,1,0,0"]) == 0
+
+
+def test_lazy_checkpoint_recovery():
+    # LazyCheckPoint under failure (reference lazy_recover.cc)
+    assert run_cluster(4, "recover_worker.py",
+                       extra_args=["mock=1,2,1,0"],
+                       env={"LAZY": "1"}) == 0

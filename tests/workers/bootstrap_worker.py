@@ -1,0 +1,48 @@
+"""Bootstrap-cache worker: performs collectives BEFORE load_checkpoint
+(the pattern rabit_bootstrap_cache=1 exists for — reference
+allreduce_robust.cc:89-141). A restarted worker must replay the pre-load
+results from surviving holders without disturbing post-load sequence
+numbering."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init()
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+
+    # --- pre-load collectives (bootstrap-cached, consume no seqnos) ----
+    cfg = rabit.broadcast({"lr": 0.1, "seed": 42} if rank == 0 else None, 0)
+    assert cfg["seed"] == 42
+    stats = rabit.allreduce(np.full(8, float(rank + 1), np.float64),
+                            rabit.SUM)
+    np.testing.assert_allclose(stats, np.full(8, world * (world + 1) / 2))
+
+    # --- load + train loop --------------------------------------------
+    version, model = rabit.load_checkpoint()
+    if version == 0:
+        model = {"iter": 0, "lr": cfg["lr"]}
+    assert model["lr"] == 0.1
+
+    for it in range(model["iter"], 4):
+        out = rabit.allreduce(np.full(16, float(rank + it), np.float32),
+                              rabit.SUM)
+        expect = sum(r + it for r in range(world))
+        np.testing.assert_allclose(out, np.full(16, expect))
+        model["iter"] = it + 1
+        rabit.checkpoint(model)
+
+    rabit.tracker_print(f"bootstrap_worker rank {rank}/{world} OK")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()
