@@ -220,16 +220,20 @@ NetResult Comm::TryAllreduceTree(char* buf, size_t elem_size, size_t count,
     else children.push_back(tree_idx_[i]);
   }
   // segment boundary must be element-aligned or the fold can never
-  // reach S (and the next segment would start mid-element)
+  // reach S (and the next segment would start mid-element); the scratch
+  // budget (reduce_buffer_) covers ALL per-child buffers together, so
+  // divide by the child count before sizing a segment
+  const size_t per_child =
+      reduce_buffer_ / std::max<size_t>(children.size(), 1);
   const size_t seg_max =
-      std::max<size_t>(reduce_buffer_ / elem_size, 1) * elem_size;
+      std::max<size_t>(per_child / elem_size, 1) * elem_size;
+  std::vector<std::vector<char>> cbuf(children.size());
+  for (auto& b : cbuf) b.resize(std::min<size_t>(seg_max, total));
 
   for (size_t seg_off = 0; seg_off < total; seg_off += seg_max) {
     const size_t S = std::min(seg_max, total - seg_off);
     char* base = buf + seg_off;
-    std::vector<std::vector<char>> cbuf(children.size());
     std::vector<size_t> crecv(children.size(), 0);
-    for (auto& b : cbuf) b.resize(S);
     size_t reduced = children.empty() ? S : 0;
     size_t sent_up = 0;
     size_t down_recv = (parent_link < 0) ? reduced : 0;

@@ -105,12 +105,16 @@ bool RobustComm::RecoverExec(void* buf, size_t size, uint32_t flag,
     }
     if (act.flags & kLoadBootstrap) {
       bool mine = (flag & kLoadBootstrap) != 0;
-      NetResult res = TryServeBootstrap(buf, size, mine, cache_key);
+      // Only ONE requester is elected and filled per round; an unelected
+      // requester must loop into the next round, or it would return with
+      // an untouched buffer and cache garbage.
+      bool served = false;
+      NetResult res = TryServeBootstrap(buf, size, mine, cache_key, &served);
       if (res != NetResult::kOk) {
         CheckAndRecover(res);
         continue;
       }
-      if (mine) return true;
+      if (served) return true;
       continue;
     }
     if (min_seq != max_seq) {
@@ -238,7 +242,8 @@ NetResult RobustComm::TryServeReplay(uint32_t seq, void* buf, size_t size,
 }
 
 NetResult RobustComm::TryServeBootstrap(void* buf, size_t size, bool mine,
-                                        const std::string& cache_key) {
+                                        const std::string& cache_key,
+                                        bool* served) {
   // elect one requester per round, it broadcasts its key, then the
   // elected holder broadcasts the cached value
   auto rv = MaxKeyRank(mine ? 1 : 0);
@@ -273,6 +278,7 @@ NetResult RobustComm::TryServeBootstrap(void* buf, size_t size, bool mine,
     RT_CHECK(len == size, "bootstrap replay size mismatch for " + key);
     memcpy(buf, payload.data(), size);
   }
+  if (served) *served = lead;
   return NetResult::kOk;
 }
 

@@ -78,7 +78,7 @@ class RobustComm : public Comm {
   NetResult TryServeReplay(uint32_t seq, void* buf, size_t size,
                            bool i_am_requester);
   NetResult TryServeBootstrap(void* buf, size_t size, bool mine,
-                              const std::string& cache_key);
+                              const std::string& cache_key, bool* served);
   NetResult TryReplicateLocal();
   // log the just-completed op's result for replay (or, for pre-load
   // bootstrap ops, into the signature-keyed cache without a seqno)

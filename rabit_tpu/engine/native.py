@@ -16,8 +16,8 @@ RbtAllreduceEx, keeping replay working through the binding.
 from __future__ import annotations
 
 import ctypes
-import inspect
 import os
+import sys
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
@@ -79,11 +79,13 @@ def _load() -> ctypes.CDLL:
     return lib
 
 
-def _caller_site(depth: int = 3) -> str:
-    """file::line caller signature (reference rabit.h:26-39 semantics)."""
+def _caller_site(depth: int = 2) -> str:
+    """file::line caller signature (reference rabit.h:26-39 semantics).
+    sys._getframe reads the one frame directly — inspect.stack() would
+    walk the whole stack and read source files on every collective."""
     try:
-        frame = inspect.stack()[depth]
-        return f"{os.path.basename(frame.filename)}::{frame.lineno}"
+        frame = sys._getframe(depth)
+        return f"{os.path.basename(frame.f_code.co_filename)}::{frame.f_lineno}"
     except Exception:  # pragma: no cover
         return ""
 
@@ -93,13 +95,17 @@ class NativeEngine(Engine):
         self._lib = _load()
         self._variant = variant
         self._key_counts: dict = {}
+        self._loaded = False
 
     def _cache_key(self, site: str, size: int) -> bytes:
         """Deterministic replay key: caller site + payload size + an
         occurrence counter, so repeated same-site pre-load calls get
         distinct keys that are stable across process restarts (the
-        reference keys on file::line::caller#nbytes, rabit.h:26-39)."""
-        if not site:
+        reference keys on file::line::caller#nbytes, rabit.h:26-39).
+        Keys only matter for the pre-LoadCheckpoint bootstrap cache, so
+        key generation stops after the first load (and _key_counts stays
+        bounded by the number of pre-load call sites)."""
+        if not site or self._loaded:
             return b""
         base = f"{site}#{size}"
         n = self._key_counts.get(base, 0)
@@ -128,7 +134,8 @@ class NativeEngine(Engine):
         assert buf.flags["C_CONTIGUOUS"]
         dtype_enum = DTYPE_ENUM[np.dtype(buf.dtype)]
         cache_key = key.encode() if key else \
-            self._cache_key(_caller_site(), buf.nbytes)
+            self._cache_key("" if self._loaded else _caller_site(3),
+                            buf.nbytes)
         if prepare_fun is None:
             cb = _PREPARE_CB()
         else:
@@ -142,7 +149,7 @@ class NativeEngine(Engine):
 
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:
         # two-phase: 8-byte length then payload (reference rabit.py:171-206)
-        site = _caller_site()
+        site = "" if self._loaded else _caller_site(3)
         length = np.zeros(1, dtype=np.uint64)
         if self.rank == root:
             if data is None:
@@ -183,6 +190,7 @@ class NativeEngine(Engine):
         lbytes = None
         if with_local and version > 0 and llen.value:
             lbytes = bytes(lptr[:llen.value])
+        self._loaded = True
         return (version, gbytes, lbytes)
 
     def checkpoint(self, global_bytes: bytes,

@@ -85,6 +85,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--timeout", type=float, default=300.0)
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
+    if args.cmd and args.cmd[0] == "--":
+        args.cmd = args.cmd[1:]
     if not args.cmd:
         ap.error("missing worker command")
     return launch(args.num_workers, args.cmd, args.max_attempts,

@@ -24,6 +24,39 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import pytest  # noqa: E402
 import rabit_tpu  # noqa: E402
 
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ensure_native_built() -> None:
+    """Build librabit_tpu_core.so if missing or stale, so the recovery /
+    integration tiers always run (the reference's CI builds its C++
+    library before every test run, scripts/travis_script.sh)."""
+    import glob
+    import subprocess
+    lib = os.path.join(_ROOT, "native", "build", "librabit_tpu_core.so")
+    srcs = glob.glob(os.path.join(_ROOT, "native", "src", "*")) + \
+        glob.glob(os.path.join(_ROOT, "native", "include", "*")) + \
+        [os.path.join(_ROOT, "native", "CMakeLists.txt")]
+    if os.path.isfile(lib) and \
+            os.path.getmtime(lib) >= max(map(os.path.getmtime, srcs)):
+        return
+    try:
+        subprocess.run(
+            ["cmake", "-S", os.path.join(_ROOT, "native"),
+             "-B", os.path.join(_ROOT, "native", "build"),
+             "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+            check=True, capture_output=True, timeout=120)
+        subprocess.run(
+            ["cmake", "--build", os.path.join(_ROOT, "native", "build")],
+            check=True, capture_output=True, timeout=300)
+    except Exception as e:  # leave skip-based reporting to the tests
+        detail = getattr(e, "stderr", b"") or b""
+        print(f"[conftest] native build failed: {e}\n"
+              f"{detail.decode(errors='replace')}", file=sys.stderr)
+
+
+_ensure_native_built()
+
 
 @pytest.fixture
 def single_engine():

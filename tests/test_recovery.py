@@ -65,6 +65,16 @@ def test_bootstrap_cache_recovery():
                                    "mock=2,1,0,0"]) == 0
 
 
+def test_bootstrap_two_simultaneous_requesters():
+    # TWO ranks die pre-LoadCheckpoint and both raise kLoadBootstrap in
+    # the same consensus round; only one is elected per round — the other
+    # must loop instead of returning an unfilled buffer (regression for
+    # the unelected-requester early-return bug)
+    assert run_cluster(4, "bootstrap_worker.py",
+                       extra_args=["rabit_bootstrap_cache=1",
+                                   "mock=1,1,0,0", "mock=2,1,0,0"]) == 0
+
+
 def test_lazy_checkpoint_recovery():
     # LazyCheckPoint under failure (reference lazy_recover.cc)
     assert run_cluster(4, "recover_worker.py",
