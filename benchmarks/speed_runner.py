@@ -1,0 +1,47 @@
+"""Collective-performance sweep — the role of the reference's
+test/speed_runner.py: run the C++ speed_test across data sizes and
+worker counts and print a table.
+
+Usage:
+    python benchmarks/speed_runner.py [--sizes 10000,100000,1000000]
+                                      [--workers 2,4,8] [--nrep 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SPEED = os.path.join(ROOT, "native", "build", "speed_test")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sizes", default="10000,100000,1000000")
+    ap.add_argument("--workers", default="2,4,8")
+    ap.add_argument("--nrep", type=int, default=10)
+    args = ap.parse_args()
+
+    if not os.path.isfile(SPEED):
+        print("build first: cmake -S native -B native/build -G Ninja && "
+              "ninja -C native/build", file=sys.stderr)
+        return 1
+
+    sys.path.insert(0, ROOT)
+    from rabit_tpu.tracker.launch import launch
+
+    for w in map(int, args.workers.split(",")):
+        for n in map(int, args.sizes.split(",")):
+            print(f"### workers={w} ndata={n}", flush=True)
+            rc = launch(w, [SPEED, f"ndata={n}", f"nrep={args.nrep}"],
+                        timeout=600.0)
+            if rc != 0:
+                return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,30 @@
+// Broadcast example using the public C++ API (the role of the
+// reference's guide/broadcast.cc): raw-buffer, string, and vector
+// overloads from a chosen root.
+#include <rabit_tpu/rabit.h>
+
+#include <cstdio>
+#include <string>
+#include <vector>
+
+int main(int argc, char* argv[]) {
+  rabit::Init(argc, argv);
+  const int rank = rabit::GetRank();
+  const int world = rabit::GetWorldSize();
+  const int root = world > 1 ? 1 : 0;
+
+  std::string msg;
+  if (rank == root) msg = "hello from the root";
+  rabit::Broadcast(&msg, root);
+  if (msg != "hello from the root") return 1;
+
+  std::vector<int32_t> table;
+  if (rank == root) table = {2, 3, 5, 7, 11};
+  rabit::Broadcast(&table, root);
+  if (table.size() != 5 || table[4] != 11) return 1;
+
+  std::printf("worker %d/%d got \"%s\" and %zu ints\n", rank, world,
+              msg.c_str(), table.size());
+  rabit::Finalize();
+  return 0;
+}

@@ -1,0 +1,51 @@
+"""Minimal Python worker (the role of the reference's guide/basic.py):
+lazy allreduce + checkpointed loop, restart-safe.
+
+Run locally:
+    python -m rabit_tpu.tracker.launch -n 3 python examples/py/basic.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init()
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+
+    version, model = rabit.load_checkpoint()
+    if version == 0:
+        model = {"iter": 0}
+
+    for it in range(model["iter"], 5):
+        vals = np.zeros(3, dtype=np.float32)
+
+        def prepare(buf, it=it):
+            buf[:] = [rank + i + it for i in range(3)]
+
+        vals = rabit.allreduce(vals, rabit.SUM, prepare_fun=prepare)
+        expect = sum(r + it for r in range(world))
+        np.testing.assert_allclose(vals[0], expect)
+
+        mx = rabit.allreduce(
+            np.array([rank * 10], np.int32), rabit.MAX)
+        assert mx[0] == (world - 1) * 10
+
+        model["iter"] = it + 1
+        rabit.checkpoint(model)
+
+    if rank == 0:
+        rabit.tracker_print(
+            f"basic.py finished, version={rabit.version_number()}\n")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""Broadcast example (the role of the reference's guide/broadcast.py):
+any picklable object travels from root to all workers."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init()
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+    root = 1 if world > 1 else 0
+
+    obj = {"msg": "hello", "table": [2, 3, 5, 7]} if rank == root else None
+    obj = rabit.broadcast(obj, root)
+    assert obj["msg"] == "hello" and obj["table"][3] == 7
+
+    print(f"worker {rank}/{world} got {obj!r}")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Lazy-prepare + lazy-checkpoint example (the role of the reference's
+guide/lazy_allreduce.py): prepare_fun only runs when the reduction truly
+executes (skipped on recovery replay)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init()
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+
+    version, model = rabit.load_checkpoint()
+    if version == 0:
+        model = {"it": 0}
+
+    for it in range(model["it"], 4):
+        grad = np.zeros(8, dtype=np.float64)
+
+        def prepare(buf, it=it):
+            print(f"rank {rank}: computing gradient for iter {it}",
+                  flush=True)
+            buf[:] = rank + 1.0
+
+        grad = rabit.allreduce(grad, rabit.SUM, prepare_fun=prepare)
+        np.testing.assert_allclose(grad, world * (world + 1) / 2.0)
+        model["it"] = it + 1
+        rabit.lazy_checkpoint(model)
+
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -36,6 +36,17 @@ int RbtAllreduce(void* sendrecvbuf, size_t count, int dtype, int op,
 int RbtAllreduceEx(void* sendrecvbuf, size_t count, int dtype, int op,
                    void (*prepare_fun)(void*), void* prepare_arg,
                    const char* cache_key);
+/* custom elementwise reducer over opaque fixed-size elements, for the
+ * C++ Reducer/SerializeReducer templates (reference rabit.h:326-430;
+ * engine.h:248-293 ReduceHandle). dst[i] = red(dst[i], src[i], ctx).
+ * Like the whole API, not thread-safe: one custom reduction at a time. */
+typedef void (*RbtReduceFn)(void* dst, const void* src, size_t count,
+                            void* ctx);
+int RbtAllreduceRaw(void* sendrecvbuf, size_t elem_size, size_t count,
+                    RbtReduceFn red, void* red_ctx,
+                    void (*prepare_fun)(void*), void* prepare_arg,
+                    const char* cache_key);
+
 int RbtBroadcast(void* sendrecvbuf, uint64_t size, int root);
 /* same, with a replay cache key (bootstrap cache) */
 int RbtBroadcastEx(void* sendrecvbuf, uint64_t size, int root,

@@ -122,6 +122,32 @@ int RbtAllreduce(void* sendrecvbuf, size_t count, int dtype, int op,
                         prepare_arg, "");
 }
 
+// trampoline context for custom reducers: the engine's ReduceFn carries
+// no user pointer, so stash (fn, ctx) in globals for the duration of the
+// call — safe because the API is documented single-threaded, matching
+// the reference's static-buffer C ABI (c_api.cc:219-245).
+static RbtReduceFn g_custom_red = nullptr;
+static void* g_custom_ctx = nullptr;
+
+static void CustomReduceTrampoline(void* dst, const void* src, size_t n) {
+  g_custom_red(dst, src, n, g_custom_ctx);
+}
+
+int RbtAllreduceRaw(void* sendrecvbuf, size_t elem_size, size_t count,
+                    RbtReduceFn red, void* red_ctx,
+                    void (*prepare_fun)(void*), void* prepare_arg,
+                    const char* cache_key) {
+  RT_API_BEGIN();
+  g_custom_red = red;
+  g_custom_ctx = red_ctx;
+  GetComm()->Allreduce(sendrecvbuf, elem_size, count, CustomReduceTrampoline,
+                       prepare_fun, prepare_arg,
+                       cache_key ? cache_key : "");
+  g_custom_red = nullptr;
+  g_custom_ctx = nullptr;
+  RT_API_END();
+}
+
 int RbtBroadcastEx(void* sendrecvbuf, uint64_t size, int root,
                    const char* cache_key) {
   RT_API_BEGIN();

@@ -1,0 +1,155 @@
+// Single-process unit tests for the public C++ header API
+// (native/include/rabit_tpu/rabit.h) — the role of the reference's
+// test/cpp gtest tier, written as a plain asserting executable so no
+// test framework dependency is needed.
+#include <rabit_tpu/rabit.h>
+
+// Release builds define NDEBUG, which no-ops CHECK(); tests must
+// always check.
+#define CHECK(cond)                                              \
+  do {                                                           \
+    if (!(cond)) {                                               \
+      std::fprintf(stderr, "CHECK failed %s:%d: %s\n", __FILE__, \
+                   __LINE__, #cond);                             \
+      std::exit(1);                                              \
+    }                                                            \
+  } while (0)
+
+#include <cmath>
+#include <cstdlib>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+static int g_prepare_calls = 0;
+
+struct Model : public rabit::Serializable {
+  std::vector<double> w;
+  void Load(rabit::Stream* fi) override {
+    uint64_t n = 0;
+    fi->Read(&n, sizeof(n));
+    w.resize(n);
+    if (n) fi->Read(w.data(), n * sizeof(double));
+  }
+  void Save(rabit::Stream* fo) const override {
+    uint64_t n = w.size();
+    fo->Write(&n, sizeof(n));
+    if (n) fo->Write(w.data(), n * sizeof(double));
+  }
+};
+
+struct Pair {
+  int a, b;
+};
+static void ReducePair(Pair& d, const Pair& s) {
+  d.a += s.a;
+  if (s.b > d.b) d.b = s.b;
+}
+
+struct Blob : public rabit::Serializable {
+  int x = 0;
+  void Load(rabit::Stream* fi) override { fi->Read(&x, sizeof(x)); }
+  void Save(rabit::Stream* fo) const override { fo->Write(&x, sizeof(x)); }
+  void Reduce(const Blob& src, size_t) { x += src.x; }
+};
+
+static void TestStreams() {
+  std::string buf;
+  rabit::MemoryBufferStream ms(&buf);
+  double pi = 3.14159;
+  ms.Write(&pi, sizeof(pi));
+  ms.Write("abc", 3);
+  ms.Seek(0);
+  double back = 0;
+  CHECK(ms.Read(&back, sizeof(back)) == sizeof(back));
+  CHECK(back == pi);
+  char s[4] = {0};
+  CHECK(ms.Read(s, 3) == 3 && std::memcmp(s, "abc", 3) == 0);
+  CHECK(ms.Read(s, 3) == 0);  // exhausted
+
+  char region[16];
+  rabit::MemoryFixSizeBuffer fb(region, sizeof(region));
+  int v = 42;
+  fb.Write(&v, sizeof(v));
+  fb.Seek(0);
+  int got = 0;
+  fb.Read(&got, sizeof(got));
+  CHECK(got == 42);
+  std::printf("streams ok\n");
+}
+
+static void TestSingleNodeCollectives() {
+  // world 1: collectives are identity but prepare_fun must still run
+  // (reference engine_empty.cc:23-133 contract)
+  std::vector<float> x(4, 0.f);
+  rabit::Allreduce<rabit::op::Sum>(x.data(), x.size(), [&]() {
+    ++g_prepare_calls;
+    for (auto& v : x) v = 7.f;
+  });
+  CHECK(g_prepare_calls == 1);
+  CHECK(x[0] == 7.f);
+
+  std::string msg = "solo";
+  rabit::Broadcast(&msg, 0);
+  CHECK(msg == "solo");
+
+  std::vector<int32_t> vec{1, 2, 3};
+  rabit::Broadcast(&vec, 0);
+  CHECK(vec.size() == 3 && vec[2] == 3);
+  std::printf("single-node collectives ok\n");
+}
+
+static void TestCheckpointRoundtrip() {
+  Model m;
+  CHECK(rabit::LoadCheckPoint(&m) == 0);
+  m.w = {1.0, 2.5, -3.0};
+  rabit::CheckPoint(&m);
+  CHECK(rabit::VersionNumber() == 1);
+
+  Model m2;
+  int version = rabit::LoadCheckPoint(&m2);
+  CHECK(version == 1);
+  CHECK(m2.w.size() == 3 && m2.w[1] == 2.5);
+
+  m2.w.push_back(9.0);
+  rabit::LazyCheckPoint(&m2);
+  CHECK(rabit::VersionNumber() == 2);
+  Model m3;
+  CHECK(rabit::LoadCheckPoint(&m3) == 2);
+  CHECK(m3.w.size() == 4 && m3.w[3] == 9.0);
+  std::printf("checkpoint roundtrip ok\n");
+}
+
+static void TestCustomReducers() {
+  rabit::Reducer<Pair, ReducePair> red;
+  std::vector<Pair> p(2);
+  p[0] = {3, 5};
+  p[1] = {-1, 0};
+  red.Allreduce(p.data(), p.size());
+  CHECK(p[0].a == 3 && p[0].b == 5);  // world 1: unchanged
+
+  rabit::SerializeReducer<Blob> sred;
+  std::vector<Blob> blobs(2);
+  blobs[0].x = 11;
+  blobs[1].x = 22;
+  sred.Allreduce(blobs.data(), sizeof(int), blobs.size());
+  CHECK(blobs[0].x == 11 && blobs[1].x == 22);  // world 1 roundtrip
+  std::printf("custom reducers ok\n");
+}
+
+int main(int argc, char* argv[]) {
+  rabit::Init(argc, argv);
+  CHECK(rabit::GetRank() == 0);
+  CHECK(rabit::GetWorldSize() == 1);
+  CHECK(!rabit::IsDistributed());
+  CHECK(!rabit::GetProcessorName().empty());
+
+  TestStreams();
+  TestSingleNodeCollectives();
+  TestCheckpointRoundtrip();
+  TestCustomReducers();
+
+  rabit::Finalize();
+  std::printf("api_test: all ok\n");
+  return 0;
+}

@@ -1,0 +1,84 @@
+// Collective-performance harness — the role of the reference's
+// test/speed_test.cc: time Allreduce(Sum/Max) and Broadcast over nrep
+// repetitions, allreduce the per-rank timings to report cluster
+// mean/min/max and effective MB/s.
+//
+// Usage (under the tracker):
+//   python -m rabit_tpu.tracker.launch -n 4 ./speed_test ndata=100000 nrep=20
+#include <rabit_tpu/rabit.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+static double Now() {
+  using namespace std::chrono;
+  return duration<double>(steady_clock::now().time_since_epoch()).count();
+}
+
+struct Timing {
+  double sum_s = 0, min_s = 1e30, max_s = 0;
+  void Add(double s) {
+    sum_s += s;
+    if (s < min_s) min_s = s;
+    if (s > max_s) max_s = s;
+  }
+};
+
+static void Report(const char* name, Timing t, int nrep, size_t nbytes) {
+  // cluster-wide stats ride the same engine being measured
+  double stats[3] = {t.sum_s, t.min_s, -t.max_s};
+  rabit::Allreduce<rabit::op::Sum>(&stats[0], 1);
+  rabit::Allreduce<rabit::op::Min>(&stats[1], 1);
+  rabit::Allreduce<rabit::op::Min>(&stats[2], 1);
+  if (rabit::GetRank() == 0) {
+    double mean = stats[0] / (nrep * rabit::GetWorldSize());
+    double mbs = nbytes / mean / 1e6;
+    std::printf("%-12s mean %.6fs  min %.6fs  max %.6fs  %.1f MB/s\n",
+                name, mean, stats[1], -stats[2], mbs);
+  }
+}
+
+int main(int argc, char* argv[]) {
+  rabit::Init(argc, argv);
+  size_t ndata = 100000;
+  int nrep = 20;
+  for (int i = 1; i < argc; ++i) {
+    unsigned long v = 0;
+    if (std::sscanf(argv[i], "ndata=%lu", &v) == 1) ndata = v;
+    if (std::sscanf(argv[i], "nrep=%lu", &v) == 1) nrep = int(v);
+  }
+  const int rank = rabit::GetRank();
+  const size_t nbytes = ndata * sizeof(float);
+  std::vector<float> buf(ndata);
+
+  Timing t_sum, t_max, t_bcast;
+  for (int r = 0; r < nrep; ++r) {
+    for (size_t i = 0; i < ndata; ++i) buf[i] = float(rank + r + i % 17);
+    double t0 = Now();
+    rabit::Allreduce<rabit::op::Sum>(buf.data(), ndata);
+    t_sum.Add(Now() - t0);
+
+    for (size_t i = 0; i < ndata; ++i) buf[i] = float(rank * (r + 1));
+    t0 = Now();
+    rabit::Allreduce<rabit::op::Max>(buf.data(), ndata);
+    t_max.Add(Now() - t0);
+
+    t0 = Now();
+    rabit::Broadcast(buf.data(), nbytes, r % rabit::GetWorldSize());
+    t_bcast.Add(Now() - t0);
+  }
+
+  if (rank == 0) {
+    std::printf("== speed_test: %zu floats (%zu bytes) x %d reps, "
+                "world=%d ==\n",
+                ndata, nbytes, nrep, rabit::GetWorldSize());
+  }
+  Report("allreduce.sum", t_sum, nrep, nbytes);
+  Report("allreduce.max", t_max, nrep, nbytes);
+  Report("broadcast", t_bcast, nrep, nbytes);
+  rabit::Finalize();
+  return 0;
+}

@@ -32,27 +32,38 @@ def _ensure_native_built() -> None:
     integration tiers always run (the reference's CI builds its C++
     library before every test run, scripts/travis_script.sh)."""
     import glob
+    import shutil
     import subprocess
     lib = os.path.join(_ROOT, "native", "build", "librabit_tpu_core.so")
-    srcs = glob.glob(os.path.join(_ROOT, "native", "src", "*")) + \
-        glob.glob(os.path.join(_ROOT, "native", "include", "*")) + \
-        [os.path.join(_ROOT, "native", "CMakeLists.txt")]
-    if os.path.isfile(lib) and \
-            os.path.getmtime(lib) >= max(map(os.path.getmtime, srcs)):
+    srcs = [p for pat in ("src/**/*", "include/**/*", "CMakeLists.txt")
+            for p in glob.glob(os.path.join(_ROOT, "native", pat),
+                               recursive=True) if os.path.isfile(p)] + \
+        glob.glob(os.path.join(_ROOT, "examples", "cc", "*.cc")) + \
+        glob.glob(os.path.join(_ROOT, "native", "test", "*.cc"))
+    stale = os.path.isfile(lib) and \
+        os.path.getmtime(lib) < max(map(os.path.getmtime, srcs))
+    if os.path.isfile(lib) and not stale:
         return
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
     try:
         subprocess.run(
             ["cmake", "-S", os.path.join(_ROOT, "native"),
              "-B", os.path.join(_ROOT, "native", "build"),
-             "-G", "Ninja", "-DCMAKE_BUILD_TYPE=Release"],
+             *gen, "-DCMAKE_BUILD_TYPE=Release"],
             check=True, capture_output=True, timeout=120)
         subprocess.run(
-            ["cmake", "--build", os.path.join(_ROOT, "native", "build")],
+            ["cmake", "--build", os.path.join(_ROOT, "native", "build"),
+             "--parallel"],
             check=True, capture_output=True, timeout=300)
-    except Exception as e:  # leave skip-based reporting to the tests
-        detail = getattr(e, "stderr", b"") or b""
-        print(f"[conftest] native build failed: {e}\n"
-              f"{detail.decode(errors='replace')}", file=sys.stderr)
+    except Exception as e:
+        detail = (getattr(e, "stderr", b"") or b"").decode(errors="replace")
+        if stale:
+            # silently testing stale binaries against edited sources would
+            # report green for broken code — fail the run instead
+            pytest.exit(f"native rebuild failed with stale {lib}:\n"
+                        f"{e}\n{detail}", returncode=3)
+        print(f"[conftest] native build failed: {e}\n{detail}",
+              file=sys.stderr)
 
 
 _ensure_native_built()

@@ -1,0 +1,54 @@
+"""The shipped examples and C++ test/perf binaries must actually run —
+single-process, multi-process under the tracker, and under fault
+injection (the reference's guide/*.cc,*.py double as its smoke tests)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tests.test_integration import LIB, ROOT
+
+BUILD = os.path.join(ROOT, "native", "build")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isfile(LIB), reason="native core not built")
+
+
+def launch_prog(nworkers, prog_argv, timeout=120):
+    from rabit_tpu.tracker.launch import launch
+    return launch(nworkers, list(prog_argv), timeout=timeout)
+
+
+def test_api_test_binary():
+    # single-process C++ header-API unit tests
+    out = subprocess.run([os.path.join(BUILD, "api_test")],
+                         capture_output=True, timeout=60)
+    assert out.returncode == 0, out.stderr.decode()
+    assert b"all ok" in out.stdout
+
+
+@pytest.mark.parametrize("ex", ["basic", "broadcast", "lazy_allreduce",
+                                "custom_reducer"])
+def test_cc_example(ex):
+    assert launch_prog(3, [os.path.join(BUILD, f"example_{ex}")]) == 0
+
+
+def test_cc_example_with_failure():
+    # one scripted death mid-loop; the respawned worker must catch up
+    assert launch_prog(
+        3, [os.path.join(BUILD, "example_basic"), "mock=1,2,0,0"]) == 0
+
+
+@pytest.mark.parametrize("ex", ["basic", "broadcast", "lazy_allreduce"])
+def test_py_example(ex):
+    assert launch_prog(
+        3, [sys.executable, os.path.join(ROOT, "examples", "py",
+                                         f"{ex}.py")]) == 0
+
+
+def test_speed_test_small():
+    # perf harness runs and reports (tiny size: this is a smoke test)
+    assert launch_prog(
+        3, [os.path.join(BUILD, "speed_test"), "ndata=1000", "nrep=3"]) == 0
