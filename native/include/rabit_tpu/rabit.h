@@ -206,6 +206,12 @@ inline bool Init(int argc, char* argv[]) {
 /// Shut the engine down (must be the program's last rabit call).
 inline bool Finalize() { return RbtFinalize() == 0; }
 
+/// Reset engine state after catching an exception mid-collective so the
+/// next collective starts clean (reference IEngine::InitAfterException,
+/// allreduce_robust.h:163-169). Returns false (with RbtGetLastError set)
+/// on the non-robust engines.
+inline bool InitAfterException() { return RbtInitAfterException() == 0; }
+
 inline int GetRank() { return RbtGetRank(); }
 inline int GetWorldSize() { return RbtGetWorldSize(); }
 inline bool IsDistributed() { return RbtIsDistributed() != 0; }

@@ -62,6 +62,11 @@ int RbtCheckpoint(const char* global, uint64_t global_len,
 int RbtLazyCheckpoint(const char* global, uint64_t global_len);
 int RbtVersionNumber(void);
 
+/* In-process reset after the caller caught an exception mid-collective
+ * (reference IEngine::InitAfterException, allreduce_robust.h:163-169);
+ * robust engine only. */
+int RbtInitAfterException(void);
+
 /* last error message for bindings (empty string if none) */
 const char* RbtGetLastError(void);
 

@@ -8,6 +8,7 @@
 #include <string>
 
 #include "comm.h"
+#include "engine_mpi.h"
 #include "mock.h"
 #include "robust.h"
 
@@ -26,6 +27,14 @@ Comm* NewCommFromEnv(int argc, const char* const* argv) {
   }
   if (variant == "base" || variant == "native") return new Comm();
   if (variant == "mock") return new MockComm();
+  if (variant == "mpi") {
+#ifdef RT_WITH_MPI
+    return new MpiComm();
+#else
+    rt::Fail("rabit_engine=mpi but this build has no MPI "
+             "(configure with an MPI toolchain to enable it)");
+#endif
+  }
   return new RobustComm();
 }
 
@@ -54,6 +63,12 @@ const char* RbtGetLastError(void) { return rt::LastError().c_str(); }
 int RbtInit(int argc, const char** argv) {
   RT_API_BEGIN();
   rt::InitComm(argc, argv);
+  RT_API_END();
+}
+
+int RbtInitAfterException(void) {
+  RT_API_BEGIN();
+  GetComm()->InitAfterException();
   RT_API_END();
 }
 

@@ -18,7 +18,10 @@ void Comm::SetupFromConfig(const Config& cfg) {
   // reference allreduce_base.cc:266-268
   tracker_port_ = static_cast<int>(cfg.GetInt("rabit_tracker_port", 9091));
   task_id_ = cfg.Get("rabit_task_id", "0");
-  num_attempt_ = static_cast<int>(cfg.GetInt("rabit_num_trial", 0));
+  // RABIT_NUM_TRIAL and DMLC_NUM_ATTEMPT (which normalizes to
+  // rabit_num_attempt) both name the restart-attempt counter
+  num_attempt_ = static_cast<int>(cfg.GetInt(
+      "rabit_num_trial", cfg.GetInt("rabit_num_attempt", 0)));
   ring_mincount_ = static_cast<size_t>(
       cfg.GetInt("rabit_reduce_ring_mincount", 32 << 10));
   reduce_buffer_ = cfg.GetSize("rabit_reduce_buffer", 256u << 20);
@@ -33,6 +36,7 @@ void Comm::SetupFromConfig(const Config& cfg) {
 void Comm::Init(int argc, const char* const* argv) {
   cfg_.LoadEnv();
   cfg_.LoadArgs(argc, argv);
+  cfg_.LoadHadoopEnv();  // last: explicit env/argv settings win
   SetupFromConfig(cfg_);
   if (tracker_uri_.empty()) {
     rank_ = 0;

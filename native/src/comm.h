@@ -38,8 +38,16 @@ class Comm {
 
   int rank() const { return rank_; }
   int world_size() const { return world_; }
-  bool is_distributed() const { return tracker_uri_ != ""; }
+  virtual bool is_distributed() const { return tracker_uri_ != ""; }
   const std::string& host() const { return host_; }
+
+  // In-process reset after the caller caught an exception mid-collective
+  // (reference IEngine::InitAfterException, allreduce_robust.h:163-169):
+  // drop any half-streamed link state so the next collective starts
+  // clean. Only the robust engine can honor it.
+  virtual void InitAfterException() {
+    Fail("InitAfterException requires the robust engine");
+  }
 
   // Lazy data-prep hook (reference prepare_fun, engine.h:74-96): invoked
   // right before the reduction executes, skipped when the robust engine

@@ -47,6 +47,31 @@ class Config {
     }
   }
 
+  // Hadoop-streaming autodetect (reference allreduce_base.cc:70-104):
+  // inside a Hadoop task, mapred_tip_id names the logical task (stable
+  // across restarts -> task id) and mapred_task_id ends in the attempt
+  // counter ("attempt_<job>_m_000003_4" -> trial 4). Explicit DMLC/RABIT
+  // settings win, so call this LAST — after both LoadEnv and LoadArgs.
+  void LoadHadoopEnv() {
+    const char* tip = getenv("mapred_tip_id");
+    if (tip == nullptr) tip = getenv("mapreduce_task_id");
+    if (tip != nullptr && Get("rabit_task_id").empty()) {
+      Set("rabit_task_id", tip);
+    }
+    const char* att = getenv("mapred_task_id");
+    if (att == nullptr) att = getenv("mapreduce_task_attempt_id");
+    // DMLC_NUM_ATTEMPT normalizes to rabit_num_attempt; either explicit
+    // form must win over the Hadoop-derived value
+    if (att != nullptr && Get("rabit_num_trial").empty() &&
+        Get("rabit_num_attempt").empty()) {
+      std::string s(att);
+      auto us = s.rfind('_');
+      if (us != std::string::npos && us + 1 < s.size()) {
+        Set("rabit_num_trial", s.substr(us + 1));
+      }
+    }
+  }
+
   void LoadArgs(int argc, const char* const* argv) {
     for (int i = 0; i < argc; ++i) {
       std::string a(argv[i]);

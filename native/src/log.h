@@ -10,6 +10,7 @@
 #include <cstdarg>
 #include <cstdio>
 #include <cstdlib>
+#include <ctime>
 #include <stdexcept>
 #include <string>
 
@@ -48,6 +49,13 @@ inline std::string StrFormat(const char* fmt, ...) {
 inline void LogInfo(const std::string& msg) {
   fprintf(stderr, "[rabit_tpu] %s\n", msg.c_str());
   fflush(stderr);
+}
+
+// Monotonic wall clock in seconds (reference utils::GetTime, timer.h:21-38).
+inline double GetTime() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<double>(ts.tv_sec) + 1e-9 * ts.tv_nsec;
 }
 
 }  // namespace rt

@@ -4,6 +4,16 @@
 // parameters script a process kill (exit 255) at exactly that engine
 // call, with ntrial fed from the tracker's restart-attempt counter so
 // each respawn advances the schedule (allreduce_mock.h:34-44,149-181).
+// Also carries the reference mock's two test adapters:
+//  - report_stats=1: per-version checkpoint sizes + cumulative
+//    allreduce/broadcast seconds, printed to the tracker at each
+//    checkpoint (allreduce_mock.h:95-103);
+//  - force_local=1: reroutes a global-only checkpoint through the
+//    local-checkpoint ring path, so global-only test programs exercise
+//    local replication/healing (the role of the reference's
+//    DummySerializer/ComboSerializer, allreduce_mock.h:73-92,122-147 —
+//    our engine checkpoints opaque strings, so the payload simply rides
+//    the local slot and is handed back as the global model on load).
 #ifndef RT_MOCK_H_
 #define RT_MOCK_H_
 
@@ -31,6 +41,52 @@ class MockComm : public RobustComm {
         Fail("bad mock entry (want rank,version,seqno,ntrial): " + e);
       }
     }
+    report_stats_ = cfg_.GetBool("report_stats", false) ||
+                    cfg_.GetBool("rabit_report_stats", false);
+    force_local_ = cfg_.GetBool("force_local", false) ||
+                   cfg_.GetBool("rabit_force_local", false);
+  }
+
+  void Allreduce(void* buf, size_t elem_size, size_t count, ReduceFn reducer,
+                 PrepareFn prepare = nullptr, void* prepare_arg = nullptr,
+                 const char* cache_key = "") override {
+    double t0 = GetTime();
+    RobustComm::Allreduce(buf, elem_size, count, reducer, prepare,
+                          prepare_arg, cache_key);
+    collective_seconds_ += GetTime() - t0;
+  }
+
+  void Broadcast(void* buf, size_t size, int root,
+                 const char* cache_key = "") override {
+    double t0 = GetTime();
+    RobustComm::Broadcast(buf, size, root, cache_key);
+    collective_seconds_ += GetTime() - t0;
+  }
+
+  void Checkpoint(const std::string& global, const std::string& local)
+      override {
+    if (force_local_) {
+      RT_CHECK(local.empty(),
+               "force_local expects a global-only checkpoint to reroute");
+      RobustComm::Checkpoint("", global);
+    } else {
+      RobustComm::Checkpoint(global, local);
+    }
+    if (report_stats_) {
+      TrackerPrint(StrFormat(
+          "[mock] rank %d version %d: global %zu B, local %zu B, "
+          "collectives %.6f s\n", rank_, version_number(), global.size(),
+          local.size(), collective_seconds_));
+    }
+  }
+
+  int LoadCheckpoint(std::string* global, std::string* local) override {
+    if (!force_local_) return RobustComm::LoadCheckpoint(global, local);
+    std::string g, l;
+    int version = RobustComm::LoadCheckpoint(&g, &l);
+    if (global) *global = l;  // payload rode the local slot
+    if (local) local->clear();
+    return version;
   }
 
  protected:
@@ -49,6 +105,9 @@ class MockComm : public RobustComm {
 
  private:
   std::set<std::tuple<int, int, int, int>> kill_points_;
+  bool report_stats_ = false;
+  bool force_local_ = false;
+  double collective_seconds_ = 0.0;
 };
 
 }  // namespace rt

@@ -1,5 +1,6 @@
 #include "robust.h"
 
+#include <algorithm>
 #include <cstring>
 
 namespace rt {
@@ -34,6 +35,16 @@ void RobustComm::Init(int argc, const char* const* argv) {
   bootstrap_cache_enabled_ = cfg_.GetBool("rabit_bootstrap_cache", false);
   num_local_replica_ =
       static_cast<int>(cfg_.GetInt("rabit_local_replica", 2));
+  num_global_replica_ =
+      static_cast<int>(cfg_.GetInt("rabit_global_replica", 5));
+  result_round_ = (num_global_replica_ > 0)
+      ? static_cast<uint32_t>(std::max(1, world_ / num_global_replica_))
+      : 1;  // <=0: keep every result on every rank
+}
+
+void RobustComm::InitAfterException() {
+  if (!is_distributed()) return;  // single-node: nothing to reset
+  CheckAndRecover(NetResult::kReset);
 }
 
 void RobustComm::Shutdown() {
@@ -324,10 +335,18 @@ void RobustComm::Allreduce(void* buf, size_t elem_size, size_t count,
     return;
   }
   if (prepare) prepare(prepare_arg);
+  double t0 = debug_ ? GetTime() : 0.0;
   std::string pristine(static_cast<char*>(buf), size);
   for (;;) {
     NetResult res = TryAllreduce(buf, elem_size, count, reducer);
     if (res == NetResult::kOk) {
+      // per-op latency trace (reference rabit_debug logging,
+      // allreduce_robust.cc:206-210,262-268)
+      if (debug_) {
+        LogInfo(StrFormat("rank %d allreduce version=%d seq=%u bytes=%zu "
+                          "key=%s %.6fs", rank_, version_, seq_counter_,
+                          size, key.c_str(), GetTime() - t0));
+      }
       FinishOp(buf, size, key, bootstrap_op);
       return;
     }
@@ -367,10 +386,16 @@ void RobustComm::Broadcast(void* buf, size_t size, int root,
     FinishOp(buf, size, key, bootstrap_op);
     return;
   }
+  double t0 = debug_ ? GetTime() : 0.0;
   std::string pristine(static_cast<char*>(buf), size);
   for (;;) {
     NetResult res = TryBroadcast(static_cast<char*>(buf), size, root);
     if (res == NetResult::kOk) {
+      if (debug_) {
+        LogInfo(StrFormat("rank %d broadcast version=%d seq=%u bytes=%zu "
+                          "key=%s %.6fs", rank_, version_, seq_counter_,
+                          size, key.c_str(), GetTime() - t0));
+      }
       FinishOp(buf, size, key, bootstrap_op);
       return;
     }
@@ -392,8 +417,13 @@ void RobustComm::FinishOp(const void* buf, size_t size,
         std::string(static_cast<const char*>(buf), size);
     return;
   }
-  result_log_[seq_counter_] =
-      std::string(static_cast<const char*>(buf), size);
+  // rotating ownership: only ~num_global_replica ranks keep each seqno
+  if (result_round_ <= 1 ||
+      seq_counter_ % result_round_ ==
+          static_cast<uint32_t>(rank_) % result_round_) {
+    result_log_[seq_counter_] =
+        std::string(static_cast<const char*>(buf), size);
+  }
   ++seq_counter_;
 }
 

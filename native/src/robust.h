@@ -38,6 +38,7 @@ class RobustComm : public Comm {
   void LazyCheckpoint(const std::string* global) override;
   void Init(int argc, const char* const* argv) override;
   void Shutdown() override;
+  void InitAfterException() override;
 
  public:
   // consensus word (reference ActionSummary, allreduce_robust.h:200-298):
@@ -86,11 +87,15 @@ class RobustComm : public Comm {
                 bool bootstrap);
 
   // result log since last checkpoint (reference ResultBuffer,
-  // allreduce_robust.h:300-364; rotating-ownership thinning not yet
-  // applied — every rank keeps every result, bounded by checkpoint
-  // cadence like the reference)
+  // allreduce_robust.h:300-364), thinned by rotating ownership: rank r
+  // stores seqno s only when s % result_round_ == r % result_round_,
+  // result_round_ = max(1, world/num_global_replica) (reference
+  // allreduce_robust.cc:43-47,185-189), so each result has
+  // ~num_global_replica holders and replay survives that many deaths
   std::map<uint32_t, std::string> result_log_;
   uint32_t seq_counter_ = 0;
+  int num_global_replica_ = 5;
+  uint32_t result_round_ = 1;
 
   // bootstrap cache: pre-LoadCheckpoint collectives keyed by caller
   // signature (reference allreduce_robust.cc:89-141)

@@ -255,9 +255,17 @@ def version_number() -> int:
     return _require_engine().version_number
 
 
+def init_after_exception() -> None:
+    """Reset engine state after catching an exception mid-collective so
+    the next collective starts clean (IEngine::InitAfterException,
+    allreduce_robust.h:163-169). Robust engine only."""
+    _require_engine().init_after_exception()
+
+
 __all__ = [
     "init", "finalize", "get_rank", "get_world_size", "is_distributed",
     "get_processor_name", "tracker_print", "allreduce", "broadcast",
     "load_checkpoint", "checkpoint", "lazy_checkpoint", "version_number",
+    "init_after_exception",
     "MAX", "MIN", "SUM", "BITOR",
 ]

@@ -65,6 +65,13 @@ class Engine(ABC):
         (IEngine::LazyCheckPoint, engine.h:155-166)."""
         self._version += 1
 
+    def init_after_exception(self) -> None:
+        """Reset engine state after the caller caught an exception
+        mid-collective (IEngine::InitAfterException,
+        allreduce_robust.h:163-169). Only the robust engine can honor it."""
+        raise NotImplementedError(
+            "InitAfterException requires the robust engine")
+
     # -- properties -------------------------------------------------------
     _version: int = 0
 

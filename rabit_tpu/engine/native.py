@@ -208,6 +208,16 @@ class NativeEngine(Engine):
     def tracker_print(self, msg: str) -> None:
         self._check(self._lib.RbtTrackerPrint(msg.encode()), "tracker_print")
 
+    def init_after_exception(self) -> None:
+        try:
+            self._check(self._lib.RbtInitAfterException(),
+                        "init_after_exception")
+        except RuntimeError as e:
+            if "robust engine" in str(e):
+                # same signal as the Python-side engines (base.py)
+                raise NotImplementedError(str(e)) from None
+            raise
+
     @property
     def rank(self) -> int:
         r = self._lib.RbtGetRank()

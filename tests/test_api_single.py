@@ -91,3 +91,24 @@ def test_lazy_checkpoint(single_engine):
 def test_double_init_warns(single_engine):
     with pytest.warns(UserWarning):
         rabit_tpu.init([], engine="empty")
+
+
+def test_init_after_exception_requires_robust(single_engine):
+    # empty engine: must refuse (reference: only AllreduceRobust
+    # implements InitAfterException, allreduce_robust.h:163-169)
+    with pytest.raises(NotImplementedError):
+        rabit_tpu.init_after_exception()
+
+
+def test_init_after_exception_robust_single():
+    # robust native engine, world 1: reset is a no-op and must not raise
+    import os
+    from tests.test_integration import LIB
+    if not os.path.isfile(LIB):
+        pytest.skip("native core not built")
+    rabit_tpu.finalize()
+    rabit_tpu.init([], engine="robust")
+    try:
+        rabit_tpu.init_after_exception()
+    finally:
+        rabit_tpu.finalize()

@@ -80,3 +80,29 @@ def test_lazy_checkpoint_recovery():
     assert run_cluster(4, "recover_worker.py",
                        extra_args=["mock=1,2,1,0"],
                        env={"LAZY": "1"}) == 0
+
+
+def test_result_log_thinning_recovery():
+    # rotating-ownership result thinning: world 6 with
+    # rabit_global_replica=2 -> round 3, so each result lives on only 2
+    # ranks and replay must route from one of them (reference
+    # allreduce_robust.cc:43-47,185-189)
+    assert run_cluster(6, "recover_worker.py",
+                       extra_args=["rabit_global_replica=2",
+                                   "mock=1,2,1,0"]) == 0
+
+
+def test_force_local_reroute():
+    # mock force_local: a global-only checkpoint program exercises the
+    # local-checkpoint ring path (reference Dummy/ComboSerializer,
+    # allreduce_mock.h:73-92,122-147)
+    assert run_cluster(4, "recover_worker.py",
+                       extra_args=["force_local=1", "mock=2,2,0,0"]) == 0
+
+
+def test_report_stats_smoke():
+    # mock report_stats: per-version checkpoint sizes + collective time
+    # printed through the tracker (reference allreduce_mock.h:95-103)
+    assert run_cluster(2, "recover_worker.py",
+                       extra_args=["rabit_engine=mock",
+                                   "report_stats=1"]) == 0
