@@ -75,7 +75,7 @@ class MockComm : public RobustComm {
     if (report_stats_) {
       TrackerPrint(StrFormat(
           "[mock] rank %d version %d: global %zu B, local %zu B, "
-          "collectives %.6f s\n", rank_, version_number(), global.size(),
+          "collectives %.6f s", rank_, version_number(), global.size(),
           local.size(), collective_seconds_));
     }
   }

@@ -78,3 +78,89 @@ def pallas_available() -> bool:
         return jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover
         return False
+
+
+def _interpret() -> bool:
+    """Run pallas in interpret mode (CPU emulation) — used by the test
+    suite on the virtual CPU mesh; never on a real TPU backend."""
+    import os
+    return (os.environ.get("RABIT_PALLAS_INTERPRET") == "1"
+            and not pallas_available())
+
+
+# ---------------------------------------------------------------------------
+# Flash-attention block kernel: one online-softmax accumulation step over a
+# K/V block, the per-step compute of ring attention
+# (parallel/ring_attention.py). The scores matmul and the p·V matmul both
+# land on the MXU; the running max/denominator updates are VPU elementwise.
+# Grid is over heads; each program holds one head's [T, D] query block and
+# [S, D] K/V block in VMEM.
+# ---------------------------------------------------------------------------
+
+# Masking constant shared with the jnp block update (ring_attention
+# imports it): large-negative instead of -inf keeps exp() exact zero
+# without inf-inf = nan in masked rows.
+NEG_INF = -1e30
+
+
+def flash_block_available() -> bool:
+    """The kernel path is used on a real TPU backend (any head_dim — Mosaic
+    pads the lane dimension) or under interpret mode for tests."""
+    return pallas_available() or _interpret()
+
+
+def _flash_block_body(has_mask, sm_scale, *refs):
+    if has_mask:
+        q_ref, k_ref, v_ref, m_ref, l_ref, o_ref, mask_ref, \
+            mo_ref, lo_ref, oo_ref = refs
+    else:
+        q_ref, k_ref, v_ref, m_ref, l_ref, o_ref, \
+            mo_ref, lo_ref, oo_ref = refs
+    dot = lambda a, b, dims: jax.lax.dot_general(  # noqa: E731
+        a, b, (dims, ((), ())), preferred_element_type=jnp.float32)
+    s = dot(q_ref[0], k_ref[0], ((1,), (1,))) * sm_scale     # [T, S] f32
+    if has_mask:
+        s = jnp.where(mask_ref[:] != 0, NEG_INF, s)
+    m_old = m_ref[0]                                          # [T]
+    m_new = jnp.maximum(m_old, s.max(axis=-1))
+    alpha = jnp.exp(m_old - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    mo_ref[0] = m_new
+    lo_ref[0] = l_ref[0] * alpha + p.sum(axis=-1)
+    oo_ref[0] = o_ref[0] * alpha[:, None] + \
+        dot(p.astype(v_ref.dtype), v_ref[0], ((1,), (0,)))
+
+
+def flash_block(q, k, v, m, l, o, mask, sm_scale):
+    """Pallas twin of ring_attention's ``_block_update``: same contract
+    (q [H,T,D]; k/v [H,S,D]; m/l [H,T] f32; o [H,T,D] f32; mask [T,S]
+    bool or None) and same return (m', l', o'). Forward-only — the
+    training path uses the differentiable jnp formulation."""
+    from jax.experimental import pallas as pl
+
+    h, t, d = q.shape
+    s_len = k.shape[1]
+    has_mask = mask is not None
+    head = lambda i: (i, 0, 0)       # noqa: E731
+    head2 = lambda i: (i, 0)         # noqa: E731
+    whole = lambda i: (0, 0)         # noqa: E731
+    in_specs = [
+        pl.BlockSpec((1, t, d), head), pl.BlockSpec((1, s_len, d), head),
+        pl.BlockSpec((1, s_len, d), head), pl.BlockSpec((1, t), head2),
+        pl.BlockSpec((1, t), head2), pl.BlockSpec((1, t, d), head),
+    ]
+    ins = [q, k, v, m, l, o]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((t, s_len), whole))
+        ins.append(mask.astype(jnp.int8))
+    return pl.pallas_call(
+        functools.partial(_flash_block_body, has_mask, sm_scale),
+        grid=(h,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, t), head2), pl.BlockSpec((1, t), head2),
+                   pl.BlockSpec((1, t, d), head)],
+        out_shape=[jax.ShapeDtypeStruct((h, t), jnp.float32),
+                   jax.ShapeDtypeStruct((h, t), jnp.float32),
+                   jax.ShapeDtypeStruct((h, t, d), jnp.float32)],
+        interpret=_interpret(),
+    )(*ins)

@@ -1,0 +1,229 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference library has no attention (SURVEY §5.7) — its ring
+collectives (TryAllgatherRing/TryReduceScatterRing,
+allreduce_base.cc:751-949) are the mechanically closest primitive: a
+neighbor-exchange pipeline around a fixed ring. Ring attention is that
+same schedule carrying K/V blocks instead of reduction chunks, which is
+why it lives here next to ``ring_allreduce``: one ``ppermute`` ring, two
+payloads.
+
+Two sequence-parallel schemes, both per-shard functions to be called
+inside ``shard_map`` with the sequence dimension sharded over the axis:
+
+- ``ring_attention`` — blockwise attention with online (flash-style)
+  softmax accumulation; K/V shards rotate around the ring, one
+  ``lax.ppermute`` per step, so each rank's query block attends to the
+  full sequence while only ever holding 1/p of K/V. Memory per chip is
+  O(T_local²-ish blockwise), enabling sequences p× longer than a single
+  chip could hold. Causal masking uses global positions and starts the
+  rotation on the diagonal block so every query row sees at least
+  itself before any fully-masked block arrives (keeps the online-softmax
+  accumulators finite).
+- ``ulysses_attention`` — all-to-all head scatter: re-shard from
+  sequence-parallel to head-parallel with ``lax.all_to_all``, run dense
+  local attention over the full sequence for H/p heads, and scatter
+  back. Two all-to-alls total; preferable when heads ≥ ring size and
+  ICI all-to-all bandwidth beats p-step rotation latency.
+
+Both are differentiable (the ring loop is a ``lax.scan``; ``ppermute``
+transposes to the inverted permutation) and compile under ``jit`` with
+static shapes, so XLA can overlap the ppermute with the per-block
+matmuls (the same comm/compute overlap the reference gets from its
+chunked ring-buffer streaming, allreduce_base.cc:548-589).
+
+On a real TPU backend the per-block score/accumulate step can run as a
+Pallas flash-attention kernel (``ops.pallas_kernels.flash_block``);
+the default jnp path is used everywhere else and is numerically
+identical within bf16/f32 mixed-precision tolerance.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .collectives import shard_map, _ring_perm
+from ..ops.pallas_kernels import NEG_INF as _NEG_INF  # shared masking const
+
+
+def reference_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                        causal: bool = False,
+                        sm_scale: Optional[float] = None) -> jax.Array:
+    """Dense single-device attention, the parity oracle for the tests.
+
+    q: [T, H, D], k/v: [S, H, D] -> [T, H, D]; f32 softmax accumulation.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    scores = jnp.einsum("thd,shd->hts", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        t, s = q.shape[0], k.shape[0]
+        mask = jnp.arange(s)[None, :] > jnp.arange(t)[:, None]
+        scores = jnp.where(mask[None], _NEG_INF, scores)
+    w = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("hts,shd->thd", w.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _block_update(q, k, v, m, l, o, mask, sm_scale):
+    """One online-softmax accumulation step over a K/V block.
+
+    q: [H, T, D]; k/v: [H, S, D]; m,l: [H, T]; o: [H, T, D];
+    mask: [T, S] bool (True = masked out) or None.
+    Returns updated (m, l, o). All accumulation in f32.
+    """
+    scores = jnp.einsum("htd,hsd->hts", q, k,
+                        preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        scores = jnp.where(mask[None], _NEG_INF, scores)
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    # rows that have seen nothing yet stay at _NEG_INF; exp underflows to 0
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * alpha + p.sum(axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "hts,hsd->htd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None,
+                   use_pallas: bool = False) -> jax.Array:
+    """Blockwise ring attention over a sequence-sharded axis.
+
+    Per-shard shapes: q/k/v [T_local, H, D] where the global sequence of
+    length p * T_local is sharded in rank order over ``axis_name``.
+    Returns the local output shard [T_local, H, D].
+
+    Step s reduces the K/V block that originated at rank
+    (idx - s) mod p; step 0 is therefore the diagonal block. K/V rotate
+    to the next rank each step (the reference's ring_next link,
+    allreduce_base.cc:433-435).
+    """
+    p = lax.axis_size(axis_name)
+    t = q.shape[0]
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    if p == 1:
+        return reference_attention(q, k, v, causal, sm_scale)
+
+    qh = q.transpose(1, 0, 2)                      # [H, T, D]
+    idx = lax.axis_index(axis_name)
+    perm = _ring_perm(p)
+    q_pos = idx * t + jnp.arange(t)                # global query positions
+
+    block_fn = _block_update
+    if use_pallas:
+        from ..ops.pallas_kernels import flash_block_available, flash_block
+        if flash_block_available():
+            block_fn = flash_block
+
+    def block(m, l, o, kb, vb, src):
+        if causal:
+            kv_pos = src * t + jnp.arange(t)
+            mask = kv_pos[None, :] > q_pos[:, None]
+        else:
+            mask = None
+        return block_fn(qh, kb, vb, m, l, o, mask, sm_scale)
+
+    def step(carry, s):
+        m, l, o, kb, vb = carry
+        # rotate first, then reduce: block rotated in at step s originated
+        # at rank (idx - s) mod p; p-1 total rotations
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        m, l, o = block(m, l, o, kb, vb, (idx - s) % p)
+        return (m, l, o, kb, vb), None
+
+    m0 = jnp.full(qh.shape[:2], _NEG_INF, jnp.float32)
+    l0 = jnp.zeros(qh.shape[:2], jnp.float32)
+    o0 = jnp.zeros(qh.shape, jnp.float32)
+    # K/V travel the ring in [H, S, D] layout: one transpose up front
+    # instead of one per step
+    kh = k.transpose(1, 0, 2)
+    vh = v.transpose(1, 0, 2)
+    # resident (diagonal) block first — keeps causal accumulators finite
+    # and saves the p-th rotation
+    m0, l0, o0 = block(m0, l0, o0, kh, vh, idx)
+    (m, l, o, _, _), _ = lax.scan(step, (m0, l0, o0, kh, vh),
+                                  jnp.arange(1, p))
+    # causal guarantees l > 0 (diagonal block runs first); non-causal
+    # always sums every position
+    out = o / l[..., None]
+    return out.transpose(1, 0, 2).astype(q.dtype)
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      axis_name: str, causal: bool = False,
+                      sm_scale: Optional[float] = None) -> jax.Array:
+    """All-to-all (DeepSpeed-Ulysses style) sequence parallelism.
+
+    Per-shard q/k/v: [T_local, H, D] with H divisible by the axis size.
+    Re-shards to [T_global, H/p, D] with one tiled ``all_to_all``, runs
+    dense local attention over the full sequence for its H/p heads, and
+    scatters back to [T_local, H, D].
+    """
+    p = lax.axis_size(axis_name)
+    if p == 1:
+        return reference_attention(q, k, v, causal, sm_scale)
+    h = q.shape[1]
+    if h % p:
+        raise ValueError(f"heads {h} not divisible by axis size {p}")
+
+    def to_headpar(x):   # [T, H, D] -> [p*T, H/p, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=0,
+                              tiled=True)
+
+    def to_seqpar(x):    # [p*T, H/p, D] -> [T, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=1,
+                              tiled=True)
+
+    qg, kg, vg = to_headpar(q), to_headpar(k), to_headpar(v)
+    out = reference_attention(qg, kg, vg, causal, sm_scale)
+    return to_seqpar(out)
+
+
+# ---------------------------------------------------------------------------
+# Host-level convenience: global [T, H, D] arrays, sequence dim sharded.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit,
+                   static_argnames=("mesh", "axis", "causal", "impl"))
+def _sp_attention(q, k, v, mesh: Mesh, axis: str, causal: bool, impl: str):
+    fn = ring_attention if impl == "ring" else ulysses_attention
+    per_shard = functools.partial(fn, axis_name=axis, causal=causal)
+    f = shard_map(per_shard, mesh=mesh,
+                  in_specs=(P(axis), P(axis), P(axis)), out_specs=P(axis))
+    return f(q, k, v)
+
+
+def sequence_parallel_attention(q, k, v, mesh: Mesh, causal: bool = False,
+                                axis: Optional[str] = None,
+                                impl: str = "ring") -> jax.Array:
+    """Attention over a global [T, H, D] array whose sequence dimension is
+    sharded across ``axis`` (T divisible by the axis size). ``impl`` is
+    ``"ring"`` (blockwise K/V rotation) or ``"ulysses"`` (all-to-all head
+    scatter; needs H divisible by the axis size)."""
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
+    if axis is None:
+        axis = mesh.axis_names[0]
+    psize = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    if q.shape[0] % psize:
+        raise ValueError(
+            f"sequence length {q.shape[0]} not divisible by axis "
+            f"'{axis}' size {psize}")
+    sharding = NamedSharding(mesh, P(axis))
+    q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
+    return _sp_attention(q, k, v, mesh, axis, causal, impl)

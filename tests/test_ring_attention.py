@@ -1,0 +1,134 @@
+"""Sequence-parallel attention parity: ring attention and Ulysses
+all-to-all vs the dense single-device oracle, on the virtual 8-device
+CPU mesh (forward and gradients, causal and full)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from rabit_tpu.parallel import (
+    make_mesh, ring_attention, sequence_parallel_attention,
+    reference_attention)
+from rabit_tpu.parallel.collectives import shard_map
+
+P_DEV = 8
+T, H, D = 64, 8, 16   # global seq len, heads, head dim
+
+
+def _qkv(seed=0, t=T, h=H, d=D):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.standard_normal((t, h, d)).astype(np.float32)  # noqa
+    return mk(), mk(), mk()
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(P_DEV, ("sp",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_forward_parity(mesh, causal, impl):
+    q, k, v = _qkv()
+    want = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=causal)
+    got = sequence_parallel_attention(q, k, v, mesh, causal=causal,
+                                      impl=impl)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_uneven_heads_rejected(mesh):
+    q, k, v = _qkv(h=6)  # 6 heads not divisible by 8 ranks
+    with pytest.raises(ValueError, match="heads"):
+        sequence_parallel_attention(q, k, v, mesh, impl="ulysses")
+
+
+def test_seq_not_divisible_rejected(mesh):
+    q, k, v = _qkv(t=60)
+    with pytest.raises(ValueError, match="divisible"):
+        sequence_parallel_attention(q, k, v, mesh)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradient_parity(mesh, causal):
+    """d(loss)/d(q,k,v) through the ring matches the dense oracle —
+    exercises the scan + ppermute transpose path."""
+    q, k, v = _qkv(seed=3)
+
+    def ref_loss(q, k, v):
+        out = reference_attention(q, k, v, causal=causal)
+        return (out * out).sum()
+
+    sharding = NamedSharding(mesh, P("sp"))
+
+    @jax.jit
+    def ring_loss(q, k, v):
+        f = shard_map(
+            functools.partial(ring_attention, axis_name="sp", causal=causal),
+            mesh=mesh, in_specs=(P("sp"),) * 3, out_specs=P("sp"))
+        out = f(q, k, v)
+        return (out * out).sum()
+
+    args = tuple(jax.device_put(x, sharding) for x in (q, k, v))
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    got = jax.grad(ring_loss, argnums=(0, 1, 2))(*args)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_long_sequence_blockwise(mesh):
+    """A sequence 8x the per-chip shard runs and stays finite — the
+    long-context claim in miniature (each rank only ever holds T/8 of
+    K/V)."""
+    t = 512
+    q, k, v = _qkv(seed=7, t=t)
+    out = sequence_parallel_attention(q, k, v, mesh, causal=True)
+    assert out.shape == (t, H, D)
+    assert bool(jnp.isfinite(out).all())
+    want = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_flash_block_parity(mesh, monkeypatch, causal):
+    """The Pallas per-block kernel (interpret mode on CPU) produces the
+    same result as the jnp block update inside the full ring."""
+    monkeypatch.setenv("RABIT_PALLAS_INTERPRET", "1")
+    q, k, v = _qkv(seed=5)
+    sharding = NamedSharding(mesh, P("sp"))
+
+    f = shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=causal,
+                          use_pallas=True),
+        mesh=mesh, in_specs=(P("sp"),) * 3, out_specs=P("sp"))
+    got = jax.jit(f)(*(jax.device_put(x, sharding) for x in (q, k, v)))
+    want = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_bad_impl_rejected(mesh):
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="impl"):
+        sequence_parallel_attention(q, k, v, mesh, impl="flash")
+
+
+def test_single_rank_path():
+    """p == 1 short-circuit matches the oracle."""
+    mesh1 = make_mesh(1, ("sp",))
+    q, k, v = _qkv(seed=9, t=32)
+    out = sequence_parallel_attention(q, k, v, mesh1, causal=True)
+    want = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
