@@ -1,0 +1,236 @@
+"""Flagship long-context model: decoder-only transformer LM trained with
+a hand-sharded SPMD step over a (dp, tp, sp) mesh.
+
+This is the "every axis is real" demo the library's parallel layer
+exists for (the reference is a collective-communication library — its
+model-side obligation is the DP gradient sync, doc/guide.md:137-143;
+the tp/sp axes show the same collectives carrying tensor- and
+sequence-parallel traffic):
+
+- **dp** — batch sharded; gradients synchronized with this library's
+  ``ring_allreduce`` (the reference's core capability, TPU-native).
+- **tp** — Megatron-style tensor parallelism: QKV and MLP up-projection
+  column-sharded, output/down projections row-sharded, partials combined
+  with ``psum_identity_grad`` over the tp axis.
+- **sp** — sequence sharded; attention over the full sequence runs as
+  blockwise ring attention (``parallel.ring_attention``), K/V rotating
+  around the sp ring via ppermute. Loss terms are summed over sp.
+
+TPU-first choices: static shapes throughout, all cross-shard traffic is
+XLA collectives, matmuls sized for the MXU, and an optional ``dtype``
+knob (bf16 activations with f32 accumulation on real hardware; tests
+run f32 on the virtual CPU mesh for exact parity with the dense
+oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.collectives import (
+    ring_allreduce, shard_map, psum_identity_grad, ident_psum_grad)
+from ..parallel.ring_attention import ring_attention, reference_attention
+
+Params = Dict[str, jax.Array]
+
+
+# ---------------------------------------------------------------------------
+# Parameters. Layout notes: wq/wk/wv [E, H, D] sharded over heads (tp);
+# wo [H, D, E] row-sharded over heads; w1 [E, F] column-, w2 [F, E]
+# row-sharded; embeddings / layernorms / head replicated.
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, vocab: int = 64, n_layers: int = 2,
+                d_model: int = 32, n_heads: int = 4, d_head: int = 8,
+                d_ff: int = 64, max_t: int = 128,
+                dtype=jnp.float32) -> Params:
+    ks = jax.random.split(rng, 3 + 6 * n_layers)
+    norm = lambda k, shape, fan: (  # noqa: E731
+        jax.random.normal(k, shape) * (1.0 / np.sqrt(fan))).astype(dtype)
+    p: Params = {
+        "emb": norm(ks[0], (vocab, d_model), d_model),
+        "pos": norm(ks[1], (max_t, d_model), d_model),
+        "head": norm(ks[-1], (d_model, vocab), d_model),
+    }
+    for i in range(n_layers):
+        k = ks[2 + 6 * i: 8 + 6 * i]
+        p[f"l{i}.wq"] = norm(k[0], (d_model, n_heads, d_head), d_model)
+        p[f"l{i}.wk"] = norm(k[1], (d_model, n_heads, d_head), d_model)
+        p[f"l{i}.wv"] = norm(k[2], (d_model, n_heads, d_head), d_model)
+        p[f"l{i}.wo"] = norm(k[3], (n_heads, d_head, d_model),
+                             n_heads * d_head)
+        p[f"l{i}.w1"] = norm(k[4], (d_model, d_ff), d_model)
+        p[f"l{i}.w2"] = norm(k[5], (d_ff, d_model), d_ff)
+        p[f"l{i}.ln1"] = jnp.ones((d_model,), dtype)
+        p[f"l{i}.ln2"] = jnp.ones((d_model,), dtype)
+    p["lnf"] = jnp.ones((d_model,), dtype)
+    return p
+
+
+def param_specs(params: Params) -> Dict[str, P]:
+    """PartitionSpec per parameter for the (dp, tp, sp) mesh."""
+    specs: Dict[str, P] = {}
+    for name, val in params.items():
+        if name.endswith((".wq", ".wk", ".wv")):
+            specs[name] = P(None, "tp", None)     # heads column-sharded
+        elif name.endswith(".wo"):
+            specs[name] = P("tp", None, None)     # heads row-sharded
+        elif name.endswith(".w1"):
+            specs[name] = P(None, "tp")
+        elif name.endswith(".w2"):
+            specs[name] = P("tp", None)
+        else:
+            specs[name] = P()                     # replicated
+    return specs
+
+
+def n_layers_of(params: Params) -> int:
+    return 1 + max(int(k[1:k.index(".")]) for k in params if k[0] == "l"
+                   and "." in k)
+
+
+def _ln(x: jax.Array, scale: jax.Array) -> jax.Array:
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale
+
+
+# ---------------------------------------------------------------------------
+# Forward. ``attn_fn(q, k, v)`` maps [B, T, H_loc, D]^3 -> [B, T, H_loc, D]
+# (causal); ``enter``/``combine`` bracket each tensor-parallel region
+# (Megatron's f/g operators: identity-forward/psum-backward on the way in,
+# psum-forward/identity-backward on the way out). The same code runs the
+# sharded path and the dense oracle (enter = combine = identity), so
+# parity tests compare identical math.
+# ---------------------------------------------------------------------------
+
+def _forward(params: Params, tokens: jax.Array, pos_ids: jax.Array,
+             attn_fn, enter, combine) -> jax.Array:
+    x = params["emb"][tokens] + params["pos"][pos_ids]
+    for i in range(n_layers_of(params)):
+        h = enter(_ln(x, params[f"l{i}.ln1"]))
+        q = jnp.einsum("bte,ehd->bthd", h, params[f"l{i}.wq"])
+        k = jnp.einsum("bte,ehd->bthd", h, params[f"l{i}.wk"])
+        v = jnp.einsum("bte,ehd->bthd", h, params[f"l{i}.wv"])
+        a = attn_fn(q, k, v)
+        x = x + combine(jnp.einsum("bthd,hde->bte", a, params[f"l{i}.wo"]))
+        h = enter(_ln(x, params[f"l{i}.ln2"]))
+        up = jax.nn.gelu(jnp.einsum("bte,ef->btf", h, params[f"l{i}.w1"]))
+        x = x + combine(jnp.einsum("btf,fe->bte", up, params[f"l{i}.w2"]))
+    return jnp.einsum("bte,ev->btv", _ln(x, params["lnf"]), params["head"])
+
+
+def forward_reference(params: Params, tokens: jax.Array) -> jax.Array:
+    """Dense single-device forward — the parity oracle. [B, T] -> logits."""
+    pos_ids = jnp.arange(tokens.shape[1])
+    attn = jax.vmap(functools.partial(reference_attention, causal=True))
+    ident = lambda x: x  # noqa: E731
+    return _forward(params, tokens, pos_ids, attn, ident, ident)
+
+
+def _shard_forward(params: Params, tokens: jax.Array, sp_axis: str,
+                   tp_axis: str) -> jax.Array:
+    """Per-shard forward: tokens [B_loc, T_loc]; params local tp shards."""
+    t_loc = tokens.shape[1]
+    pos_ids = lax.axis_index(sp_axis) * t_loc + jnp.arange(t_loc)
+    attn = jax.vmap(functools.partial(
+        ring_attention, axis_name=sp_axis, causal=True))
+    enter = functools.partial(ident_psum_grad, axis_name=tp_axis)
+    combine = functools.partial(psum_identity_grad, axis_name=tp_axis)
+    return _forward(params, tokens, pos_ids, attn, enter, combine)
+
+
+def _local_loss(params: Params, tokens: jax.Array, targets: jax.Array,
+                sp_axis: str, tp_axis: str, dp_axis: str) -> jax.Array:
+    """This rank's *partial* of the global mean NLL: local nll sum over
+    the global token count. Kept local (no psum) so ``jax.grad`` yields
+    exactly this rank's contribution — psum-ing the loss before grad
+    would inflate cotangents by dp*sp through the psum transpose. The
+    replicated global loss is ``psum`` of this over (dp, sp)."""
+    logits = _shard_forward(params, tokens, sp_axis, tp_axis)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).sum()
+    count = tokens.size * lax.psum(1, (dp_axis, sp_axis))
+    return nll / count
+
+
+def make_train_step(mesh: Mesh, lr: float = 0.1):
+    """Jitted SGD step over the (dp, tp, sp) mesh.
+
+    ``step(params, tokens, targets) -> (new_params, loss)`` with tokens /
+    targets [B, T] sharded P(dp, sp) and params laid out per
+    ``param_specs``. Gradient synchronization over dp uses this library's
+    ring allreduce; sp partial gradients are psum-reduced (tp gradients
+    are already local to each shard).
+    """
+    dp_axis, tp_axis, sp_axis = mesh.axis_names
+
+    def per_shard(params, tokens, targets):
+        partial, grads = jax.value_and_grad(_local_loss)(
+            params, tokens, targets, sp_axis, tp_axis, dp_axis)
+        loss = lax.psum(partial, (dp_axis, sp_axis))
+
+        def sync(g):
+            g = lax.psum(g, sp_axis)                     # sum sp partials
+            flat = g.reshape(-1)
+            flat = ring_allreduce(flat, dp_axis)          # sum dp partials
+            return flat.reshape(g.shape)
+
+        grads = jax.tree.map(sync, grads)
+        new_params = jax.tree.map(
+            lambda p, g: (p - lr * g).astype(p.dtype), params, grads)
+        return new_params, loss
+
+    @jax.jit
+    def step(params, tokens, targets):
+        specs = param_specs(params)
+        f = shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
+            out_specs=(specs, P()))
+        return f(params, tokens, targets)
+
+    return step
+
+
+def make_forward(mesh: Mesh):
+    """Jitted sharded forward returning logits [B, T, V] (for parity
+    tests and inference)."""
+    dp_axis, tp_axis, sp_axis = mesh.axis_names
+
+    @jax.jit
+    def fwd(params, tokens):
+        specs = param_specs(params)
+        f = shard_map(
+            functools.partial(_shard_forward, sp_axis=sp_axis,
+                              tp_axis=tp_axis),
+            mesh=mesh, in_specs=(specs, P(dp_axis, sp_axis)),
+            out_specs=P(dp_axis, sp_axis))
+        return f(params, tokens)
+
+    return fwd
+
+
+def make_sharded_inputs(mesh: Mesh, batch: int, seq: int, vocab: int = 64,
+                        seed: int = 0, **sizes
+                        ) -> Tuple[Params, jax.Array, jax.Array]:
+    """Params placed per ``param_specs`` and random (tokens, targets)
+    sharded P(dp, sp) — ready for ``make_train_step``."""
+    params = init_params(jax.random.PRNGKey(seed), vocab=vocab,
+                         max_t=max(seq, 128), **sizes)
+    specs = param_specs(params)
+    params = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+              for k, v in params.items()}
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab, size=(batch, seq + 1))
+    sh = NamedSharding(mesh, P(mesh.axis_names[0], mesh.axis_names[2]))
+    tokens = jax.device_put(toks[:, :-1].astype(np.int32), sh)
+    targets = jax.device_put(toks[:, 1:].astype(np.int32), sh)
+    return params, tokens, targets

@@ -153,7 +153,7 @@ def flash_block(q, k, v, m, l, o, mask, sm_scale):
     if has_mask:
         in_specs.append(pl.BlockSpec((t, s_len), whole))
         ins.append(mask.astype(jnp.int8))
-    return pl.pallas_call(
+    call = pl.pallas_call(
         functools.partial(_flash_block_body, has_mask, sm_scale),
         grid=(h,),
         in_specs=in_specs,
@@ -163,4 +163,17 @@ def flash_block(q, k, v, m, l, o, mask, sm_scale):
                    jax.ShapeDtypeStruct((h, t), jnp.float32),
                    jax.ShapeDtypeStruct((h, t, d), jnp.float32)],
         interpret=_interpret(),
-    )(*ins)
+    )
+
+    @jax.custom_jvp
+    def run(*arrs):
+        return call(*arrs)
+
+    @run.defjvp
+    def _no_ad(primals, tangents):  # noqa: ANN001
+        raise NotImplementedError(
+            "flash_block is forward-only (no AD rule for the Pallas "
+            "kernel); use the default jnp path (use_pallas=False) when "
+            "differentiating")
+
+    return run(*ins)

@@ -174,6 +174,28 @@ def psum_identity_grad(x: jax.Array, axis_name: str) -> jax.Array:
     return f(x)
 
 
+def ident_psum_grad(x: jax.Array, axis_name: str) -> jax.Array:
+    """Identity whose backward pass is ``lax.psum`` over ``axis_name`` —
+    the conjugate of :func:`psum_identity_grad`.
+
+    Place it where a replicated activation *enters* a model-parallel
+    region (before einsums with axis-sharded weights): each shard's
+    backward then contributes only its local paths, and this operator
+    collects them into the full cotangent, so gradients of everything
+    upstream come out complete and identical on every shard of the axis.
+    (Megatron's f/g conjugate-operator pair: this is f, and
+    ``psum_identity_grad`` — applied where partial results *leave* the
+    region — is g.)
+    """
+    @jax.custom_vjp
+    def f(v):
+        return v
+
+    f.defvjp(lambda v: (v, None),
+             lambda _, g: (lax.psum(g, axis_name),))
+    return f(x)
+
+
 def bcast_from_root(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
     """Broadcast rank ``root``'s value to all ranks (TryBroadcast,
     allreduce_base.cc:649-737): mask non-root contributions to the

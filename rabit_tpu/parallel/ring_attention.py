@@ -199,10 +199,16 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.jit,
-                   static_argnames=("mesh", "axis", "causal", "impl"))
-def _sp_attention(q, k, v, mesh: Mesh, axis: str, causal: bool, impl: str):
-    fn = ring_attention if impl == "ring" else ulysses_attention
-    per_shard = functools.partial(fn, axis_name=axis, causal=causal)
+                   static_argnames=("mesh", "axis", "causal", "impl",
+                                    "use_pallas"))
+def _sp_attention(q, k, v, mesh: Mesh, axis: str, causal: bool, impl: str,
+                  use_pallas: bool):
+    if impl == "ring":
+        per_shard = functools.partial(ring_attention, axis_name=axis,
+                                      causal=causal, use_pallas=use_pallas)
+    else:
+        per_shard = functools.partial(ulysses_attention, axis_name=axis,
+                                      causal=causal)
     f = shard_map(per_shard, mesh=mesh,
                   in_specs=(P(axis), P(axis), P(axis)), out_specs=P(axis))
     return f(q, k, v)
@@ -210,11 +216,14 @@ def _sp_attention(q, k, v, mesh: Mesh, axis: str, causal: bool, impl: str):
 
 def sequence_parallel_attention(q, k, v, mesh: Mesh, causal: bool = False,
                                 axis: Optional[str] = None,
-                                impl: str = "ring") -> jax.Array:
+                                impl: str = "ring",
+                                use_pallas: bool = False) -> jax.Array:
     """Attention over a global [T, H, D] array whose sequence dimension is
     sharded across ``axis`` (T divisible by the axis size). ``impl`` is
     ``"ring"`` (blockwise K/V rotation) or ``"ulysses"`` (all-to-all head
-    scatter; needs H divisible by the axis size)."""
+    scatter; needs H divisible by the axis size). ``use_pallas`` runs the
+    ring path's per-block step as the Pallas flash kernel — forward-only
+    (inference / benchmarking); leave False when differentiating."""
     if impl not in ("ring", "ulysses"):
         raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
     if axis is None:
@@ -226,4 +235,4 @@ def sequence_parallel_attention(q, k, v, mesh: Mesh, causal: bool = False,
             f"'{axis}' size {psize}")
     sharding = NamedSharding(mesh, P(axis))
     q, k, v = (jax.device_put(x, sharding) for x in (q, k, v))
-    return _sp_attention(q, k, v, mesh, axis, causal, impl)
+    return _sp_attention(q, k, v, mesh, axis, causal, impl, use_pallas)

@@ -117,6 +117,36 @@ def test_pallas_flash_block_parity(mesh, monkeypatch, causal):
                                rtol=2e-5, atol=2e-5)
 
 
+def test_pallas_via_public_wrapper(mesh, monkeypatch):
+    monkeypatch.setenv("RABIT_PALLAS_INTERPRET", "1")
+    q, k, v = _qkv(seed=11)
+    got = sequence_parallel_attention(q, k, v, mesh, causal=True,
+                                      use_pallas=True)
+    want = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_pallas_forward_only_guard(mesh, monkeypatch):
+    """Differentiating the pallas path fails with a clear message, not an
+    opaque pallas_call AD error."""
+    monkeypatch.setenv("RABIT_PALLAS_INTERPRET", "1")
+    q, k, v = _qkv(seed=12)
+    sharding = NamedSharding(mesh, P("sp"))
+    args = tuple(jax.device_put(x, sharding) for x in (q, k, v))
+
+    def loss(q, k, v):
+        f = shard_map(
+            functools.partial(ring_attention, axis_name="sp",
+                              use_pallas=True),
+            mesh=mesh, in_specs=(P("sp"),) * 3, out_specs=P("sp"))
+        return (f(q, k, v) ** 2).sum()
+
+    with pytest.raises(NotImplementedError, match="forward-only"):
+        jax.grad(loss)(*args)
+
+
 def test_bad_impl_rejected(mesh):
     q, k, v = _qkv()
     with pytest.raises(ValueError, match="impl"):

@@ -1,0 +1,83 @@
+"""Flagship transformer: dp×tp×sp sharded forward matches the dense
+oracle, and the full SPMD training step (ring-allreduce dp grad sync,
+psum tp combines, ring-attention sp) decreases the loss."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rabit_tpu.models import transformer as tf
+from rabit_tpu.parallel import make_mesh
+
+SIZES = dict(n_layers=2, d_model=32, n_heads=4, d_head=8, d_ff=64)
+VOCAB = 64
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(8, ("dp", "tp", "sp"), (2, 2, 2))
+
+
+def test_sharded_forward_matches_dense_oracle(mesh):
+    params, tokens, _ = tf.make_sharded_inputs(
+        mesh, batch=4, seq=32, vocab=VOCAB, **SIZES)
+    got = tf.make_forward(mesh)(params, tokens)
+    dense_params = {k: np.asarray(v) for k, v in params.items()}
+    want = tf.forward_reference(
+        {k: jnp.asarray(v) for k, v in dense_params.items()},
+        jnp.asarray(np.asarray(tokens)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_train_step_decreases_loss(mesh):
+    params, tokens, targets = tf.make_sharded_inputs(
+        mesh, batch=4, seq=32, vocab=VOCAB, **SIZES)
+    step = tf.make_train_step(mesh, lr=0.5)
+    losses = []
+    for _ in range(8):
+        params, loss = step(params, tokens, targets)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0] * 0.9, losses
+
+
+def test_train_matches_dense_sgd(mesh):
+    """One sharded SGD step == one dense single-device SGD step: the
+    strongest statement that dp/tp/sp sharding changes nothing but
+    placement."""
+    params, tokens, targets = tf.make_sharded_inputs(
+        mesh, batch=4, seq=32, vocab=VOCAB, seed=3, **SIZES)
+    lr = 0.2
+    step = tf.make_train_step(mesh, lr=lr)
+    new_params, loss = step(params, tokens, targets)
+
+    dense = {k: jnp.asarray(np.asarray(v)) for k, v in params.items()}
+    toks = jnp.asarray(np.asarray(tokens))
+    tgts = jnp.asarray(np.asarray(targets))
+
+    def dense_loss(p):
+        logits = tf.forward_reference(p, toks)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        return -jnp.take_along_axis(
+            logp, tgts[..., None], axis=-1).mean()
+
+    want_loss, grads = jax.value_and_grad(dense_loss)(dense)
+    want = jax.tree.map(lambda p, g: p - lr * g, dense, grads)
+
+    assert abs(float(loss) - float(want_loss)) < 1e-4
+    for k in want:
+        np.testing.assert_allclose(
+            np.asarray(new_params[k]), np.asarray(want[k]),
+            rtol=5e-4, atol=5e-4, err_msg=k)
+
+
+def test_degenerate_axes_mesh():
+    """The same step compiles when some axes are trivial (dp=4, tp=1,
+    sp=2) — shapes a smaller pod slice would use."""
+    mesh = make_mesh(8, ("dp", "tp", "sp"), (4, 1, 2))
+    params, tokens, targets = tf.make_sharded_inputs(
+        mesh, batch=4, seq=32, vocab=VOCAB, **SIZES)
+    params, loss = tf.make_train_step(mesh, lr=0.1)(params, tokens, targets)
+    assert np.isfinite(float(loss))
