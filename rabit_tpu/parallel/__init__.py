@@ -21,3 +21,10 @@ from .ring_attention import (  # noqa: F401
     ring_attention, ulysses_attention, sequence_parallel_attention,
     reference_attention,
 )
+from .pipeline import (  # noqa: F401
+    pipeline_apply, make_pipeline_fn, stack_stage_params,
+    place_pipeline_params,
+)
+from .moe import (  # noqa: F401
+    moe_ffn, moe_reference, make_moe_fn, init_moe_params, place_moe_params,
+)

@@ -1,0 +1,164 @@
+"""Expert parallelism over an ``ep`` mesh axis.
+
+Switch-style (top-1) mixture-of-experts with one expert per rank and
+``lax.all_to_all`` token exchange — the TPU-native formulation: routing
+is expressed as dense one-hot dispatch/combine einsums (MXU-friendly, no
+scatter, static shapes with a fixed per-expert capacity) and the only
+cross-chip traffic is two all-to-alls (tokens out to their expert, results
+back), riding ICI exactly like the Ulysses head-scatter in
+``ring_attention``.
+
+No counterpart exists in the reference (SURVEY §2.2); this completes the
+parallelism families (dp/tp/sp/pp/ep) the mesh data plane serves.
+Differentiable end-to-end: gradients flow through the combine weights
+(gate probabilities), the expert FFNs, and the router.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .collectives import shard_map
+
+Params = Dict[str, jax.Array]
+
+
+def init_moe_params(rng: jax.Array, d_model: int, d_ff: int,
+                    n_experts: int, dtype=jnp.float32) -> Params:
+    """Router + per-expert FFN weights. Expert leaves are stacked
+    ``[n_experts, ...]`` — shard dim 0 over ``ep``."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    s1 = (1.0 / np.sqrt(d_model))
+    s2 = (1.0 / np.sqrt(d_ff))
+    return {
+        "router": (jax.random.normal(k1, (d_model, n_experts)) * s1
+                   ).astype(dtype),
+        "w_in": (jax.random.normal(k2, (n_experts, d_model, d_ff)) * s1
+                 ).astype(dtype),
+        "w_out": (jax.random.normal(k3, (n_experts, d_ff, d_model)) * s2
+                  ).astype(dtype),
+    }
+
+
+def moe_param_specs(axis: str) -> Params:
+    return {"router": P(), "w_in": P(axis), "w_out": P(axis)}
+
+
+def _dispatch_combine(gates: jax.Array, capacity: int
+                      ) -> Tuple[jax.Array, jax.Array]:
+    """Build one-hot dispatch and weighted combine tensors.
+
+    gates: [n, e] router probabilities. Top-1 routing: token i goes to
+    expert argmax(gates[i]) at the slot given by its order of arrival
+    among that expert's tokens; tokens beyond ``capacity`` are dropped
+    (standard Switch semantics). Returns (dispatch [n, e, c] one-hot,
+    combine [n, e, c] = dispatch * gate).
+    """
+    n, e = gates.shape
+    expert = jnp.argmax(gates, axis=-1)                      # [n]
+    onehot = jax.nn.one_hot(expert, e, dtype=gates.dtype)    # [n, e]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0          # slot per token
+    keep = (pos >= 0) & (pos < capacity)
+    slot = jnp.clip(pos, 0, capacity - 1).astype(jnp.int32)
+    dispatch = (jax.nn.one_hot(slot, capacity, dtype=gates.dtype)
+                * keep[..., None].astype(gates.dtype))       # [n, e, c]
+    gate = (gates * onehot).sum(axis=-1, keepdims=True)      # [n, 1]
+    combine = dispatch * gate[..., None]
+    return dispatch, combine
+
+
+def moe_ffn(params: Params, x: jax.Array, axis_name: str,
+            capacity_factor: float = 2.0) -> Tuple[jax.Array, jax.Array]:
+    """Per-shard switch-MoE FFN: x [n_loc, d] -> (y [n_loc, d], aux).
+
+    One expert per rank of ``axis_name`` (params["w_in"]/["w_out"] carry
+    this rank's expert at index 0). ``aux`` is the Switch load-balancing
+    loss (mean fraction-routed x mean gate mass, scaled by e²).
+    """
+    p = lax.axis_size(axis_name)
+    n_loc, d = x.shape
+    logits = x @ params["router"]                            # [n, e]
+    gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    capacity = int(np.ceil(n_loc * capacity_factor / max(p, 1)))
+    dispatch, combine = _dispatch_combine(gates, capacity)
+
+    # aux load-balance loss (Switch eq. 4): e * sum_e(frac_tokens * frac_prob)
+    frac_tokens = jax.nn.one_hot(jnp.argmax(gates, -1), gates.shape[-1],
+                                 dtype=gates.dtype).mean(axis=0)
+    frac_prob = gates.mean(axis=0)
+    # pmean so the replicated (out_specs P()) aux agrees on every rank
+    aux = lax.pmean(
+        gates.shape[-1] * (frac_tokens * frac_prob).sum(), axis_name)
+
+    # tokens -> their expert's slots: [e, c, d] on every source rank
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x.astype(gates.dtype))
+    if p > 1:
+        # exchange: expert axis split across ranks, source-rank slots
+        # concatenated -> this rank holds its expert's slots from every
+        # source rank as [p, c, d] (dim 0 = source rank)
+        expert_in = lax.all_to_all(expert_in, axis_name, split_axis=0,
+                                   concat_axis=0, tiled=True)
+
+    w_in, w_out = params["w_in"][0], params["w_out"][0]
+    h = jax.nn.gelu(expert_in.astype(x.dtype) @ w_in)        # [e|p, c, f]
+    y = h @ w_out                                            # [e|p, c, d]
+
+    if p > 1:
+        # inverse exchange: dim 0 becomes the expert axis again
+        y = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                           tiled=True)
+    out = jnp.einsum("nec,ecd->nd", combine, y.astype(gates.dtype))
+    return out.astype(x.dtype), aux
+
+
+def moe_reference(params: Params, x: jax.Array) -> jax.Array:
+    """Dense single-device oracle: every token through its argmax expert,
+    weighted by its gate (no capacity drops)."""
+    gates = jax.nn.softmax((x @ params["router"]).astype(jnp.float32), -1)
+    expert = jnp.argmax(gates, axis=-1)
+    gate = jnp.take_along_axis(gates, expert[:, None], axis=1)
+    h = jax.nn.gelu(jnp.einsum("nd,ndf->nf", x, params["w_in"][expert]))
+    y = jnp.einsum("nf,nfd->nd", h, params["w_out"][expert])
+    return (y * gate).astype(x.dtype)
+
+
+def make_moe_fn(mesh: Mesh, axis: Optional[str] = None,
+                capacity_factor: float = 2.0):
+    """Host-level wrapper: ``fn(params, x) -> (y, aux)`` with x [n, d]
+    sharded over ``axis`` (token/data dim) and expert leaves sharded one
+    expert per rank."""
+    if axis is None:
+        axis = mesh.axis_names[0]
+    ep = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+
+    @jax.jit
+    def fn(params, x):
+        if params["w_in"].shape[0] != ep:
+            raise ValueError(
+                f"one expert per rank: n_experts {params['w_in'].shape[0]} "
+                f"!= axis '{axis}' size {ep}")
+        f = shard_map(
+            functools.partial(moe_ffn, axis_name=axis,
+                              capacity_factor=capacity_factor),
+            mesh=mesh,
+            in_specs=(moe_param_specs(axis), P(axis)),
+            out_specs=(P(axis), P()))
+        return f(params, x)
+
+    return fn
+
+
+def place_moe_params(mesh: Mesh, params: Params,
+                     axis: Optional[str] = None) -> Params:
+    if axis is None:
+        axis = mesh.axis_names[0]
+    specs = moe_param_specs(axis)
+    return {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+            for k, v in params.items()}

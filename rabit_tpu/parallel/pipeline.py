@@ -1,0 +1,128 @@
+"""Pipeline parallelism over a ``pp`` mesh axis.
+
+GPipe-style microbatch pipelining expressed the TPU way: every rank
+holds one stage's parameters (stacked pytree leaves ``[pp, ...]``
+sharded over the axis), activations hop stage-to-stage with one
+``lax.ppermute`` per tick inside a ``lax.scan`` schedule, and bubbles
+are handled by masking instead of control flow — so the whole pipeline
+is a single jit-compiled SPMD program, differentiable end-to-end (the
+backward pass is automatically the reverse pipeline: scan transposes to
+reverse-scan, ppermute to the inverted permutation).
+
+The neighbor-hop structure is the same ring machinery as the
+collectives' ``ppermute`` pipelines (ring_allreduce, ring attention) —
+one mesh, one primitive family, three parallelism styles.
+
+No counterpart exists in the reference (a collective-communication
+library, SURVEY §2.2) — this rounds out the mesh data plane so model
+state too large for one chip can span stages.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .collectives import shard_map, _ring_perm
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x_micro: jax.Array,
+                   axis_name: str) -> jax.Array:
+    """Run microbatches through a p-stage pipeline (per-shard function).
+
+    ``stage_fn(params, x) -> y`` is one stage (activation shapes must be
+    identical across stages); ``stage_params`` is this rank's stage's
+    parameter pytree; ``x_micro`` is ``[n_micro, mb, ...]`` (replicated —
+    only rank 0 reads it). Returns ``[n_micro, mb, ...]`` outputs,
+    replicated via a final broadcast from the last stage.
+
+    Schedule: ``n_micro + p - 1`` ticks. At tick t, rank r computes
+    microbatch ``t - r`` (masked out when that index is out of range —
+    the pipeline bubble), then hands its activation to rank r+1.
+    """
+    p = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = x_micro.shape[0]
+    if p == 1:
+        return jax.vmap(lambda x: stage_fn(stage_params, x))(x_micro)
+    perm = _ring_perm(p)
+    mb_shape = x_micro.shape[1:]
+
+    def tick(carry, t):
+        recv, out = carry
+        # stage 0 injects a fresh microbatch; others consume the hop
+        feed = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False)
+        inp = jnp.where(idx == 0, feed, recv)
+        y = stage_fn(stage_params, inp)
+        # the last stage owns microbatch t-(p-1) at tick t
+        m = t - (p - 1)
+        valid = jnp.logical_and(idx == p - 1,
+                                jnp.logical_and(m >= 0, m < n_micro))
+        out = lax.dynamic_update_index_in_dim(
+            out, jnp.where(valid, y, lax.dynamic_index_in_dim(
+                out, jnp.clip(m, 0, n_micro - 1), 0, keepdims=False)),
+            jnp.clip(m, 0, n_micro - 1), 0)
+        recv = lax.ppermute(y, axis_name, perm)
+        return (recv, out), None
+
+    recv0 = jnp.zeros(mb_shape, x_micro.dtype)
+    out0 = jnp.zeros((n_micro,) + mb_shape, x_micro.dtype)
+    (_, out), _ = lax.scan(tick, (recv0, out0),
+                           jnp.arange(n_micro + p - 1))
+    # replicate the last stage's outputs to every rank
+    contrib = jnp.where(idx == p - 1, out, jnp.zeros_like(out))
+    return lax.psum(contrib, axis_name)
+
+
+def stack_stage_params(params_list) -> object:
+    """Stack per-stage parameter pytrees into ``[pp, ...]`` leaves (the
+    host-side layout that shards one stage per rank)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def make_pipeline_fn(mesh: Mesh, stage_fn: Callable,
+                     axis: Optional[str] = None):
+    """Host-level wrapper: ``fn(stacked_params, x_micro) -> y_micro``.
+
+    ``stacked_params`` leaves are ``[pp, ...]`` sharded over ``axis``;
+    ``x_micro`` ``[n_micro, mb, ...]`` is replicated. The per-shard
+    params drop the leading stage dim inside the shard.
+    """
+    if axis is None:
+        axis = mesh.axis_names[0]
+    pp = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+
+    def per_shard(stacked, x_micro):
+        local = jax.tree.map(lambda a: a[0], stacked)  # [1, ...] -> [...]
+        return pipeline_apply(
+            lambda prm, x: stage_fn(prm, x), local, x_micro, axis)
+
+    @jax.jit
+    def fn(stacked_params, x_micro):
+        n_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+        if n_stages != pp:
+            # a divisible mismatch would otherwise run and silently apply
+            # only every (n_stages/pp)-th stage
+            raise ValueError(
+                f"one stage per rank: {n_stages} stages != axis "
+                f"'{axis}' size {pp}")
+        specs = jax.tree.map(lambda _: P(axis), stacked_params)
+        f = shard_map(per_shard, mesh=mesh,
+                      in_specs=(specs, P()), out_specs=P())
+        return f(stacked_params, x_micro)
+
+    return fn
+
+
+def place_pipeline_params(mesh: Mesh, params_list, axis: Optional[str] = None):
+    """Stack and shard per-stage params over the pipeline axis."""
+    if axis is None:
+        axis = mesh.axis_names[0]
+    stacked = stack_stage_params(params_list)
+    return jax.tree.map(
+        lambda a: jax.device_put(a, NamedSharding(mesh, P(axis))), stacked)
