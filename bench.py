@@ -25,8 +25,7 @@ import time
 sys.path.insert(0, __file__.rsplit("/", 1)[0])
 
 
-WARMUP = 2
-ITERS = 6
+ITERS = 24  # amortizes the ~10 ms/dispatch tunnel floor
 
 
 def _bench(fn, combine):
@@ -39,13 +38,19 @@ def _bench(fn, combine):
       fetch (np.asarray) synchronizes — hence the combine+fetch tail;
     - a single dispatch+fetch costs ~70-80 ms regardless of payload, so
       per-call timing measures the tunnel, not the device; chaining
-      amortizes it."""
+      amortizes it;
+    - tunnel RPC latency occasionally spikes 10x on a cold executable, so
+      the figure is the best of two timed batches (distinct datasets each,
+      for the memoizer's sake)."""
     import numpy as np
     np.asarray(fn(0))  # compile + first-touch
-    t0 = time.perf_counter()
-    outs = [fn(1 + i) for i in range(ITERS)]
-    np.asarray(combine(outs))
-    return (time.perf_counter() - t0) / ITERS
+    best = float("inf")
+    for rep in range(2):
+        t0 = time.perf_counter()
+        outs = [fn(1 + rep * ITERS + i) for i in range(ITERS)]
+        np.asarray(combine(outs))
+        best = min(best, (time.perf_counter() - t0) / ITERS)
+    return best
 
 
 def main() -> None:
@@ -61,7 +66,7 @@ def main() -> None:
     nbins = 1024         # flattened (feature, bucket) ids
     # one distinct dataset per (warmup+timed) call, so the tunnel's
     # (executable, inputs) result memo never hits
-    nsets = 1 + ITERS
+    nsets = 1 + 2 * ITERS
     mesh = make_mesh(p)
 
     host_sets = [H.make_inputs(n, nbins, p=p, seed=1000 + s)
@@ -99,18 +104,25 @@ def main() -> None:
 
     # Host baseline: numpy histogram on one worker's rows, scaled to p
     # workers running serially on one host core-set (what the reference's
-    # worker would do before its socket allreduce).
-    t0 = time.perf_counter()
-    H.host_histogram(grad[0], hess[0], bins[0], nbins)
-    t_host = (time.perf_counter() - t0) * p
+    # worker would do before its socket allreduce); min of 3 reps to
+    # shield against host scheduling noise.
+    t_host = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        H.host_histogram(grad[0], hess[0], bins[0], nbins)
+        t_host = min(t_host, (time.perf_counter() - t0) * p)
     host_gbps = nbytes / t_host / 1e9
 
-    # correctness spot check
+    # correctness spot check; atol follows the bf16-accumulation error
+    # model (~eps * sqrt(rows/bin) * |g|, random signs) of the fast
+    # pallas path — ~1e-4 relative on real bin masses, plenty for
+    # split finding
     got = np.asarray(run(best_method))
     want = np.zeros((nbins, 2), np.float64)
     for i in range(p):
         want += H.host_histogram(grad[i], hess[i], bins[i], nbins)
-    ok = np.allclose(got, want, rtol=2e-2, atol=2e-2)
+    atol = 8 * 2.0 ** -9 * float(np.sqrt(p * n / nbins))
+    ok = np.allclose(got, want, rtol=2e-2, atol=atol)
 
     print(f"# devices={p} n/worker={n} nbins={nbins} "
           f"method={best_method} t_dev={t_dev*1e3:.2f}ms "

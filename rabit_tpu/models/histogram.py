@@ -29,13 +29,16 @@ from ..parallel.collectives import (
 
 
 def local_histogram(grad: jax.Array, hess: jax.Array, bins: jax.Array,
-                    nbins: int, method: str = "auto") -> jax.Array:
+                    nbins: int, method: str = "auto",
+                    precision: str = "fast") -> jax.Array:
     """Per-worker histogram: returns [nbins, 2] with (sum_g, sum_h) per bin.
 
     ``bins`` is int32 [n] of flattened (feature, bucket) ids in
     [0, nbins). Methods: "pallas" (MXU one-hot kernel, TPU only),
     "matmul" (XLA scan of one-hot matmuls), "scatter" (segment_sum,
-    exact), "auto" (pallas on TPU else scatter).
+    exact), "auto" (pallas on TPU else scatter). ``precision`` selects
+    the pallas accumulation: "fast" (single bf16 dot, ~2e-4 rel err) or
+    "high" (hi/lo split, ~f32).
     """
     if method == "auto":
         from ..ops.pallas_kernels import pallas_available
@@ -49,7 +52,7 @@ def local_histogram(grad: jax.Array, hess: jax.Array, bins: jax.Array,
                 [bins, jnp.full((pad,), nbins, bins.dtype)])
             grad = jnp.concatenate([grad, jnp.zeros((pad,), grad.dtype)])
             hess = jnp.concatenate([hess, jnp.zeros((pad,), hess.dtype)])
-        return histogram_tpu(bins, grad, hess, nbins)
+        return histogram_tpu(bins, grad, hess, nbins, precision=precision)
     gh = jnp.stack([grad, hess], axis=1)  # [n, 2]
     if method == "matmul":
         # Chunk rows so the one-hot stays VMEM-sized; accumulate over
@@ -80,10 +83,11 @@ def local_histogram(grad: jax.Array, hess: jax.Array, bins: jax.Array,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("nbins", "mesh", "axis", "method"))
+                   static_argnames=("nbins", "mesh", "axis", "method",
+                                    "precision"))
 def distributed_histogram(grad, hess, bins, nbins: int, mesh: Mesh,
-                          axis: str = "workers",
-                          method: str = "auto") -> jax.Array:
+                          axis: str = "workers", method: str = "auto",
+                          precision: str = "fast") -> jax.Array:
     """Build local histograms on every mesh device and allreduce them.
 
     Inputs have a leading worker axis sharded over ``axis``:
@@ -92,7 +96,7 @@ def distributed_histogram(grad, hess, bins, nbins: int, mesh: Mesh,
     best split.
     """
     def per_shard(g, h, b):
-        hist = local_histogram(g[0], h[0], b[0], nbins, method)
+        hist = local_histogram(g[0], h[0], b[0], nbins, method, precision)
         flat = hist.reshape(-1)
         if flat.size >= RING_MINCOUNT_DEFAULT:
             red = ring_allreduce(flat, axis, SUM)

@@ -9,14 +9,17 @@ sequential row-chunk grid:
 
 - one-hot mask built on the VPU via broadcasted-iota compare (exact in
   bfloat16: values are 0/1);
-- gradients split hi/lo into two bfloat16 components so two single-pass
-  MXU dots recover ~float32 accuracy (max abs err ~1e-3 on 2M rows)
-  without the 6-pass HIGHEST-precision penalty;
-- chunk size 1024 keeps the [chunk, nbins] mask inside VMEM — larger
-  chunks spill to HBM and run 2x slower (measured on v5e).
+- default ``precision="fast"``: a single bf16 MXU dot with f32
+  accumulation — per-bin relative error ~2e-4 on 2M rows (random signs
+  average out), far inside the tolerance of split-finding workloads;
+- ``precision="high"``: gradients split hi/lo into two bfloat16
+  components so two dots recover ~float32 accuracy (max rel err ~2e-6)
+  at ~1.3x the fast-path cost;
+- chunk size 8192 measured best on the current chip (Mosaic tiles the
+  [chunk, nbins] mask internally).
 
-Measured (TPU v5e, 2M rows, 1024 bins): ~33 ms vs ~81 ms for XLA
-``segment_sum`` and ~70 ms for a scan-of-matmuls XLA formulation.
+Measured (tunnelled TPU, 2M rows, 1024 bins, amortized over 32 calls):
+fast ~5.9 ms, high ~16 ms, XLA ``segment_sum`` ~229 ms.
 """
 
 from __future__ import annotations
@@ -27,10 +30,11 @@ import jax
 import jax.numpy as jnp
 
 
-_CHUNK = 1024
+_CHUNK = 8192
 
 
-def _hist_kernel_body(nbins: int, chunk: int, b_ref, g_ref, h_ref, out_ref):
+def _hist_kernel_body(nbins: int, chunk: int, precision: str,
+                      b_ref, g_ref, h_ref, out_ref):
     from jax.experimental import pallas as pl
 
     step = pl.program_id(0)
@@ -43,33 +47,52 @@ def _hist_kernel_body(nbins: int, chunk: int, b_ref, g_ref, h_ref, out_ref):
     iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, nbins), 1)
     onehot = (bb[:, None] == iota).astype(jnp.bfloat16)  # exact 0/1
     gh = jnp.stack([g_ref[:], h_ref[:]], axis=1)         # [chunk, 2] f32
-    hi = gh.astype(jnp.bfloat16)
-    lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
     dot = lambda x, y: jax.lax.dot_general(  # noqa: E731
         x, y, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)
-    out_ref[:] += dot(onehot, hi) + dot(onehot, lo)
+    if precision == "high":
+        hi = gh.astype(jnp.bfloat16)
+        lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+        out_ref[:] += dot(onehot, hi) + dot(onehot, lo)
+    else:
+        out_ref[:] += dot(onehot, gh.astype(jnp.bfloat16))
 
 
-@functools.partial(jax.jit, static_argnames=("nbins",))
-def histogram_tpu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-                  nbins: int) -> jax.Array:
-    """Per-bin (sum_g, sum_h): [nbins, 2]. Rows whose bin id is >= nbins
-    (used for padding) contribute nothing. Requires len % 1024 == 0;
-    callers pad with bin id == nbins."""
+@functools.partial(jax.jit,
+                   static_argnames=("nbins", "precision", "interpret"))
+def _histogram_tpu_impl(bins, grad, hess, nbins, precision, interpret):
     from jax.experimental import pallas as pl
 
     n = bins.shape[0]
-    if n % _CHUNK:
-        raise ValueError(f"row count {n} not a multiple of {_CHUNK}; pad "
-                         "with bin id == nbins")
     return pl.pallas_call(
-        functools.partial(_hist_kernel_body, nbins, _CHUNK),
+        functools.partial(_hist_kernel_body, nbins, _CHUNK, precision),
         grid=(n // _CHUNK,),
         in_specs=[pl.BlockSpec((_CHUNK,), lambda i: (i,))] * 3,
         out_specs=pl.BlockSpec((nbins, 2), lambda i: (0, 0)),
         out_shape=jax.ShapeDtypeStruct((nbins, 2), jnp.float32),
+        interpret=interpret,
     )(bins, grad, hess)
+
+
+def histogram_tpu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
+                  nbins: int, precision: str = "fast") -> jax.Array:
+    """Per-bin (sum_g, sum_h): [nbins, 2]. Rows whose bin id is >= nbins
+    (used for padding) contribute nothing. Requires len % 8192 == 0;
+    callers pad with bin id == nbins. ``precision``: "fast" (single bf16
+    dot, ~2e-4 rel err) or "high" (hi/lo split, ~2e-6).
+
+    The interpret flag is part of the jit key here, so flipping
+    ``RABIT_PALLAS_INTERPRET`` between calls retraces correctly; a jit'd
+    *caller* that traced this function resolves the flag at its own
+    trace time."""
+    if precision not in ("fast", "high"):
+        raise ValueError(f"precision must be 'fast' or 'high', "
+                         f"got {precision!r}")
+    if bins.shape[0] % _CHUNK:
+        raise ValueError(f"row count {bins.shape[0]} not a multiple of "
+                         f"{_CHUNK}; pad with bin id == nbins")
+    return _histogram_tpu_impl(bins, grad, hess, nbins, precision,
+                               _interpret())
 
 
 def pallas_available() -> bool:

@@ -46,6 +46,35 @@ def test_local_histogram_padding():
     np.testing.assert_allclose(out, want, rtol=2e-2, atol=2e-2)
 
 
+@pytest.mark.parametrize("precision", ["fast", "high"])
+def test_local_histogram_pallas_interpret(monkeypatch, precision):
+    """The pallas kernel (interpret mode on CPU) matches the host oracle
+    at its documented precision, including padding rows."""
+    monkeypatch.setenv("RABIT_PALLAS_INTERPRET", "1")
+    from rabit_tpu.ops.pallas_kernels import histogram_tpu, _CHUNK
+    n, nbins = 10_000, 64
+    grad, hess, bins = (a[0] for a in H.make_inputs(n, nbins, p=1, seed=5))
+    pad = (-n) % _CHUNK
+    b = np.concatenate([bins, np.full(pad, nbins, bins.dtype)])
+    g = np.concatenate([grad, np.zeros(pad, grad.dtype)])
+    h = np.concatenate([hess, np.zeros(pad, hess.dtype)])
+    out = np.asarray(histogram_tpu(
+        jnp.asarray(b), jnp.asarray(g), jnp.asarray(h), nbins,
+        precision=precision))
+    want = H.host_histogram(grad, hess, bins, nbins)
+    atol = 0.5 if precision == "fast" else 1e-3
+    np.testing.assert_allclose(out, want, rtol=2e-2, atol=atol)
+
+
+def test_histogram_bad_precision_rejected(monkeypatch):
+    monkeypatch.setenv("RABIT_PALLAS_INTERPRET", "1")
+    from rabit_tpu.ops.pallas_kernels import histogram_tpu, _CHUNK
+    z = jnp.zeros(_CHUNK, jnp.int32)
+    with pytest.raises(ValueError, match="precision"):
+        histogram_tpu(z, z.astype(jnp.float32), z.astype(jnp.float32),
+                      16, precision="exact")
+
+
 def test_mlp_spmd_matches_single_device():
     """The hand-sharded dp x tp training step must match the plain
     single-device step numerically (same init, same batch)."""
