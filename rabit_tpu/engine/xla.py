@@ -57,6 +57,12 @@ class XlaEngine(Engine):
             # already-initialized check inspects distributed state only.
             from jax._src.distributed import global_state
             if global_state.client is None:
+                # cross-process collectives on the CPU backend need an
+                # explicit implementation; without it psum/ppermute
+                # silently reduce only the local shard (only the CPU
+                # client reads this, so it is harmless on TPU/GPU)
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
                 jax.distributed.initialize(
                     coordinator_address=coord,
                     num_processes=nproc,

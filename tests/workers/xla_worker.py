@@ -1,0 +1,50 @@
+"""Multi-process XLA-engine worker: rendezvous through the JAX
+coordination service (the reference tracker's role, SURVEY §2.3), then
+allreduce over the cross-process device mesh.
+
+argv: <process_id> <num_processes> <coordinator_port>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    pid, nproc, port = sys.argv[1], sys.argv[2], sys.argv[3]
+    rabit.init(["rabit_engine=xla",
+                f"rabit_coordinator=127.0.0.1:{port}",
+                f"rabit_num_processes={nproc}",
+                f"rabit_process_id={pid}"])
+    r, w = rabit.get_rank(), rabit.get_world_size()
+    assert w == int(nproc), (r, w)
+
+    # large payload -> ring (ppermute) path
+    big = rabit.allreduce(np.full(100_000, float(r + 1), np.float32),
+                          rabit.SUM)
+    assert np.all(big == sum(range(1, w + 1))), (r, big[:3])
+
+    # small payload -> tree (psum) path
+    small = rabit.allreduce(np.arange(8, dtype=np.int32) + r, rabit.MAX)
+    assert np.all(small == np.arange(8) + (w - 1)), (r, small)
+
+    # two-phase pickle broadcast
+    obj = rabit.broadcast({"from": 0, "v": [1, 2, 3]} if r == 0 else None, 0)
+    assert obj == {"from": 0, "v": [1, 2, 3]}, (r, obj)
+
+    print(f"rank {r}/{w} OK", flush=True)
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()
