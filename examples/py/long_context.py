@@ -1,0 +1,67 @@
+"""Long-context sequence parallelism on a device mesh.
+
+Runs causal ring attention over a sequence 8x longer than any single
+chip's K/V share, checks it against the dense oracle, and trains the
+flagship (dp, tp, sp) transformer for a few steps. Works anywhere: on a
+multi-chip TPU slice the mesh covers real chips; elsewhere run it under
+a virtual mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python examples/py/long_context.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import jax  # noqa: E402
+
+if "host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from rabit_tpu.parallel import (  # noqa: E402
+    make_mesh, sequence_parallel_attention, reference_attention)
+from rabit_tpu.models import transformer as tf  # noqa: E402
+
+
+def main() -> int:
+    p = len(jax.devices())
+    mesh = make_mesh(p, ("sp",))
+    t, h, d = 512 * p, 8, 32
+    rng = np.random.default_rng(0)
+    q, k, v = (rng.standard_normal((t, h, d)).astype(np.float32)
+               for _ in range(3))
+    out = sequence_parallel_attention(q, k, v, mesh, causal=True)
+    want = reference_attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True)
+    err = float(jnp.abs(out - want).max())
+    print(f"ring attention: seq={t} over {p} chips "
+          f"({t // p} K/V rows per chip), max err vs dense = {err:.2e}")
+    assert err < 1e-4
+
+    # a few steps of the (dp, tp, sp) transformer
+    dp = 2 if p % 2 == 0 else 1
+    sp = 2 if p % 4 == 0 else 1
+    tp = p // (dp * sp)
+    mesh3 = make_mesh(p, ("dp", "tp", "sp"), (dp, tp, sp))
+    params, tokens, targets = tf.make_sharded_inputs(
+        mesh3, batch=2 * dp, seq=32 * sp, vocab=64,
+        n_layers=2, d_model=32, n_heads=max(2, tp), d_head=8, d_ff=64)
+    step = tf.make_train_step(mesh3, lr=0.3)
+    losses = []
+    for _ in range(5):
+        params, loss = step(params, tokens, targets)
+        losses.append(float(loss))
+    print(f"transformer (dp={dp}, tp={tp}, sp={sp}): "
+          + " -> ".join(f"{l:.3f}" for l in losses))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -109,6 +109,26 @@ def test_mlp_training_reduces_loss():
     assert all(np.isfinite(l) for l in losses)
 
 
+def test_py_long_context_example():
+    """The long-context example runs standalone (pure JAX, no native
+    core, no tracker); lives here rather than test_examples.py so a
+    failed native build doesn't skip it."""
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if "host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                            " --xla_force_host_platform_device_count=8")
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "examples", "py",
+                                      "long_context.py")],
+        capture_output=True, timeout=300, env=env, text=True)
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
 def test_graft_entry_contract():
     """The driver contract: entry() returns a jittable fn + args, and
     dryrun_multichip(8) compiles+runs the full sharded training step."""
