@@ -53,9 +53,40 @@ def _bench(fn, combine):
     return best
 
 
+def _probe_device(timeout_s: float = 90.0) -> None:
+    """Fail fast if the device is unreachable: the tunnelled TPU
+    occasionally goes down entirely, hanging even trivial dispatches.
+    Better to exit with a clear error than hang the driver's bench run."""
+    import threading
+    ok = threading.Event()
+    err: list = []
+
+    def touch():
+        try:
+            import jax.numpy as jnp
+            import numpy as np
+            np.asarray(jnp.ones((8,)).sum())
+            ok.set()
+        except BaseException as e:  # noqa: BLE001 — re-raised below
+            err.append(e)
+            ok.set()
+
+    t = threading.Thread(target=touch, daemon=True)
+    t.start()
+    ok.wait(timeout_s)
+    if err:
+        raise RuntimeError(f"device probe failed: {err[0]!r}") from err[0]
+    if not ok.is_set():
+        raise RuntimeError(
+            f"device unreachable: a trivial dispatch did not complete in "
+            f"{timeout_s:.0f}s (TPU tunnel down?)")
+
+
 def main() -> None:
     import jax
     import numpy as np
+
+    _probe_device()
 
     from rabit_tpu.parallel import make_mesh
     from rabit_tpu.models import histogram as H
