@@ -30,15 +30,15 @@ from ..parallel.collectives import (
 
 def local_histogram(grad: jax.Array, hess: jax.Array, bins: jax.Array,
                     nbins: int, method: str = "auto",
-                    precision: str = "fast") -> jax.Array:
+                    precision: str = "high") -> jax.Array:
     """Per-worker histogram: returns [nbins, 2] with (sum_g, sum_h) per bin.
 
     ``bins`` is int32 [n] of flattened (feature, bucket) ids in
     [0, nbins). Methods: "pallas" (MXU one-hot kernel, TPU only),
     "matmul" (XLA scan of one-hot matmuls), "scatter" (segment_sum,
     exact), "auto" (pallas on TPU else scatter). ``precision`` selects
-    the pallas accumulation: "fast" (single bf16 dot, ~2e-4 rel err) or
-    "high" (hi/lo split, ~f32).
+    the pallas accumulation: "high" (default, ~f32 accuracy) or "fast"
+    (single bf16 dot, ~2e-4 rel err — an explicit perf opt-in).
     """
     if method == "auto":
         from ..ops.pallas_kernels import pallas_available
@@ -87,7 +87,7 @@ def local_histogram(grad: jax.Array, hess: jax.Array, bins: jax.Array,
                                     "precision"))
 def distributed_histogram(grad, hess, bins, nbins: int, mesh: Mesh,
                           axis: str = "workers", method: str = "auto",
-                          precision: str = "fast") -> jax.Array:
+                          precision: str = "high") -> jax.Array:
     """Build local histograms on every mesh device and allreduce them.
 
     Inputs have a leading worker axis sharded over ``axis``:

@@ -9,12 +9,11 @@ sequential row-chunk grid:
 
 - one-hot mask built on the VPU via broadcasted-iota compare (exact in
   bfloat16: values are 0/1);
-- default ``precision="fast"``: a single bf16 MXU dot with f32
-  accumulation — per-bin relative error ~2e-4 on 2M rows (random signs
-  average out), far inside the tolerance of split-finding workloads;
-- ``precision="high"``: gradients split hi/lo into two bfloat16
-  components so two dots recover ~float32 accuracy (max rel err ~2e-6)
-  at ~1.3x the fast-path cost;
+- default ``precision="high"``: gradients split hi/lo into two bfloat16
+  components so two dots recover ~float32 accuracy (max rel err ~2e-6);
+- ``precision="fast"``: a single bf16 MXU dot with f32 accumulation —
+  per-bin relative error ~2e-4 on 2M rows (random signs average out),
+  inside split-finding tolerance; ~1.3x faster, explicit opt-in;
 - chunk size 8192 measured best on the current chip (Mosaic tiles the
   [chunk, nbins] mask internally).
 
@@ -75,11 +74,11 @@ def _histogram_tpu_impl(bins, grad, hess, nbins, precision, interpret):
 
 
 def histogram_tpu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
-                  nbins: int, precision: str = "fast") -> jax.Array:
+                  nbins: int, precision: str = "high") -> jax.Array:
     """Per-bin (sum_g, sum_h): [nbins, 2]. Rows whose bin id is >= nbins
     (used for padding) contribute nothing. Requires len % 8192 == 0;
-    callers pad with bin id == nbins. ``precision``: "fast" (single bf16
-    dot, ~2e-4 rel err) or "high" (hi/lo split, ~2e-6).
+    callers pad with bin id == nbins. ``precision``: "high" (default,
+    hi/lo split, ~2e-6 rel err) or "fast" (single bf16 dot, ~2e-4).
 
     The interpret flag is part of the jit key here, so flipping
     ``RABIT_PALLAS_INTERPRET`` between calls retraces correctly; a jit'd

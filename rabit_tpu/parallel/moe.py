@@ -84,6 +84,12 @@ def moe_ffn(params: Params, x: jax.Array, axis_name: str,
     """
     p = lax.axis_size(axis_name)
     n_loc, d = x.shape
+    if params["router"].shape[-1] != p:
+        raise ValueError(
+            f"moe_ffn requires one expert per rank: n_experts "
+            f"{params['router'].shape[-1]} != axis '{axis_name}' size {p} "
+            f"(the tiled all_to_all layout interleaves expert slots "
+            f"otherwise)")
     logits = x @ params["router"]                            # [n, e]
     gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     capacity = int(np.ceil(n_loc * capacity_factor / max(p, 1)))
@@ -136,14 +142,9 @@ def make_moe_fn(mesh: Mesh, axis: Optional[str] = None,
     expert per rank."""
     if axis is None:
         axis = mesh.axis_names[0]
-    ep = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
 
     @jax.jit
     def fn(params, x):
-        if params["w_in"].shape[0] != ep:
-            raise ValueError(
-                f"one expert per rank: n_experts {params['w_in'].shape[0]} "
-                f"!= axis '{axis}' size {ep}")
         f = shard_map(
             functools.partial(moe_ffn, axis_name=axis,
                               capacity_factor=capacity_factor),

@@ -222,10 +222,14 @@ def sequence_parallel_attention(q, k, v, mesh: Mesh, causal: bool = False,
     sharded across ``axis`` (T divisible by the axis size). ``impl`` is
     ``"ring"`` (blockwise K/V rotation) or ``"ulysses"`` (all-to-all head
     scatter; needs H divisible by the axis size). ``use_pallas`` runs the
-    ring path's per-block step as the Pallas flash kernel — forward-only
-    (inference / benchmarking); leave False when differentiating."""
+    ring path's per-block step as the Pallas flash kernel — currently
+    forward-only; leave False when differentiating."""
     if impl not in ("ring", "ulysses"):
         raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
+    if use_pallas and impl != "ring":
+        raise ValueError(
+            "use_pallas applies only to impl='ring' (the Ulysses path has "
+            "no Pallas kernel); drop use_pallas or use impl='ring'")
     if axis is None:
         axis = mesh.axis_names[0]
     psize = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]

@@ -176,6 +176,24 @@ def test_moe_expert_count_mismatch_rejected():
         make_moe_fn(mesh)(params, x)
 
 
+def test_moe_ffn_direct_mismatch_rejected():
+    """Calling the exported per-shard moe_ffn directly with n_experts a
+    multiple of the axis size must fail loudly, not silently interleave
+    expert slots."""
+    mesh = make_mesh(4, ("ep",))
+    params = init_moe_params(jax.random.PRNGKey(8), d_model=D, d_ff=32,
+                             n_experts=8)
+    f = shard_map(
+        functools.partial(moe_mod.moe_ffn, axis_name="ep"),
+        mesh=mesh,
+        in_specs=(moe_mod.moe_param_specs("ep"), P("ep")),
+        out_specs=(P("ep"), P()))
+    placed = place_moe_params(mesh, params)
+    x = jax.device_put(jnp.zeros((16, D)), NamedSharding(mesh, P("ep")))
+    with pytest.raises(ValueError, match="one expert per rank"):
+        jax.jit(f)(placed, x)
+
+
 def test_moe_training_specializes_experts():
     """A few SGD steps on a clusterable input distribution reduce loss —
     the ep pipeline trains end-to-end."""

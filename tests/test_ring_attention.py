@@ -55,9 +55,12 @@ def test_seq_not_divisible_rejected(mesh):
 
 
 @pytest.mark.parametrize("causal", [False, True])
-def test_gradient_parity(mesh, causal):
-    """d(loss)/d(q,k,v) through the ring matches the dense oracle —
-    exercises the scan + ppermute transpose path."""
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_gradient_parity(mesh, causal, impl):
+    """d(loss)/d(q,k,v) through the sequence-parallel path matches the
+    dense oracle — exercises the scan + ppermute transpose (ring) and the
+    all_to_all transpose (ulysses)."""
+    from rabit_tpu.parallel import ulysses_attention
     q, k, v = _qkv(seed=3)
 
     def ref_loss(q, k, v):
@@ -65,11 +68,12 @@ def test_gradient_parity(mesh, causal):
         return (out * out).sum()
 
     sharding = NamedSharding(mesh, P("sp"))
+    per_shard = ring_attention if impl == "ring" else ulysses_attention
 
     @jax.jit
-    def ring_loss(q, k, v):
+    def sp_loss(q, k, v):
         f = shard_map(
-            functools.partial(ring_attention, axis_name="sp", causal=causal),
+            functools.partial(per_shard, axis_name="sp", causal=causal),
             mesh=mesh, in_specs=(P("sp"),) * 3, out_specs=P("sp"))
         out = f(q, k, v)
         return (out * out).sum()
@@ -77,7 +81,7 @@ def test_gradient_parity(mesh, causal):
     args = tuple(jax.device_put(x, sharding) for x in (q, k, v))
     want = jax.grad(ref_loss, argnums=(0, 1, 2))(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
-    got = jax.grad(ring_loss, argnums=(0, 1, 2))(*args)
+    got = jax.grad(sp_loss, argnums=(0, 1, 2))(*args)
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                    rtol=5e-4, atol=5e-4)
@@ -151,6 +155,15 @@ def test_bad_impl_rejected(mesh):
     q, k, v = _qkv()
     with pytest.raises(ValueError, match="impl"):
         sequence_parallel_attention(q, k, v, mesh, impl="flash")
+
+
+def test_pallas_with_ulysses_rejected(mesh):
+    """use_pallas only applies to the ring path; silently ignoring it on
+    ulysses hid a no-op knob."""
+    q, k, v = _qkv()
+    with pytest.raises(ValueError, match="use_pallas"):
+        sequence_parallel_attention(q, k, v, mesh, impl="ulysses",
+                                    use_pallas=True)
 
 
 def test_single_rank_path():
