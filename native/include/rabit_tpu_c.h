@@ -47,6 +47,23 @@ int RbtAllreduceRaw(void* sendrecvbuf, size_t elem_size, size_t count,
                     void (*prepare_fun)(void*), void* prepare_arg,
                     const char* cache_key);
 
+/* Accelerator data-plane hook: payload allreduces with coded (dtype, op)
+ * and nbytes >= min_bytes execute through fn (the XLA device-mesh
+ * collective) instead of the socket tree/ring; sockets remain the
+ * control plane (consensus, replay, checkpoints) and the small-message
+ * path. ``epoch`` is the tracker link-registration epoch: when it
+ * advances, the callback must tear down and re-form its fixed-membership
+ * device world before reducing (get the coordinator via RbtCoordAddr).
+ * fn returns 0 on success; nonzero enters the robust recovery path. */
+typedef int (*RbtDataPlaneFn)(void* buf, uint64_t count, int dtype, int op,
+                              uint32_t epoch, void* ctx);
+int RbtSetDataPlane(RbtDataPlaneFn fn, void* ctx, uint64_t min_bytes);
+/* current tracker link-registration epoch (advances on every recovery) */
+int RbtWorldEpoch(void);
+/* "host:port" of the current epoch's device-world coordinator (rank 0);
+ * same buf/len convention as RbtGetProcessorName */
+int RbtCoordAddr(char* buf, size_t* len, size_t max_len);
+
 int RbtBroadcast(void* sendrecvbuf, uint64_t size, int root);
 /* same, with a replay cache key (bootstrap cache) */
 int RbtBroadcastEx(void* sendrecvbuf, uint64_t size, int root,

@@ -127,7 +127,34 @@ int RbtAllreduceEx(void* sendrecvbuf, size_t count, int dtype, int op,
   RT_API_BEGIN();
   rt::ReduceFn fn = rt::GetReducer(op, dtype);
   GetComm()->Allreduce(sendrecvbuf, rt::DTypeSize(dtype), count, fn,
-                       prepare_fun, prepare_arg, cache_key ? cache_key : "");
+                       prepare_fun, prepare_arg, cache_key ? cache_key : "",
+                       dtype, op);
+  RT_API_END();
+}
+
+int RbtSetDataPlane(RbtDataPlaneFn fn, void* ctx, uint64_t min_bytes) {
+  RT_API_BEGIN();
+  GetComm()->SetDataPlane(fn, ctx, static_cast<size_t>(min_bytes));
+  RT_API_END();
+}
+
+int RbtWorldEpoch(void) {
+  try {
+    return static_cast<int>(GetComm()->world_epoch());
+  } catch (const std::exception& e) {
+    rt::LastError() = e.what();
+    return -1;
+  }
+}
+
+int RbtCoordAddr(char* buf, size_t* len, size_t max_len) {
+  RT_API_BEGIN();
+  std::string addr = GetComm()->coord_host() + ":" +
+                     std::to_string(GetComm()->coord_port());
+  size_t n = addr.size() < max_len ? addr.size() : max_len;
+  memcpy(buf, addr.data(), n);
+  if (n < max_len) buf[n] = '\0';
+  *len = addr.size();
   RT_API_END();
 }
 

@@ -95,9 +95,15 @@ void Comm::ReconnectLinks(const char* cmd) {
   t.SendU32(static_cast<uint32_t>(listener_.port()));
 
   // Assignment (tracker barriers until all world_size workers register,
-  // so every peer below is already listening).
+  // so every peer below is already listening). epoch + coordinator: the
+  // tracker hosts one device-world coordination service per registration
+  // epoch — it must outlive any worker, because a vanished service
+  // fatally poisons surviving clients (see engine/dataplane.py).
   rank_ = static_cast<int>(t.RecvU32());
   world_ = static_cast<int>(t.RecvU32());
+  world_epoch_ = t.RecvU32();
+  coord_host_ = t.RecvStr();
+  coord_port_ = static_cast<int>(t.RecvU32());
   uint32_t parent_rank = t.RecvU32();
   uint32_t ntree = t.RecvU32();
   std::vector<int> tree_ranks(ntree);
@@ -173,11 +179,25 @@ void Comm::ReconnectLinks(const char* cmd) {
 
 void Comm::Allreduce(void* buf, size_t elem_size, size_t count,
                      ReduceFn reducer, PrepareFn prepare, void* prepare_arg,
-                     const char*) {
+                     const char*, int dtype, int op) {
   if (prepare != nullptr) prepare(prepare_arg);
-  NetResult r = TryAllreduce(buf, elem_size, count, reducer);
+  NetResult r = ExecuteAllreduce(buf, elem_size, count, reducer, dtype, op);
   RT_CHECK(r == NetResult::kOk, "allreduce failed (no recovery in base "
                                 "engine; use the robust engine)");
+}
+
+NetResult Comm::ExecuteAllreduce(void* buf, size_t elem_size, size_t count,
+                                 ReduceFn reducer, int dtype, int op) {
+  if (world_ > 1 && dataplane_ != nullptr && dtype >= 0 && op >= 0 &&
+      elem_size * count >= dataplane_minbytes_ && count > 0) {
+    int rc = dataplane_(buf, static_cast<uint64_t>(count), dtype, op,
+                        world_epoch_, dataplane_ctx_);
+    if (rc == 0) return NetResult::kOk;
+    // device-plane failure looks like a link failure to the caller: the
+    // robust engine reconnects (advancing the epoch) and retries
+    return NetResult::kReset;
+  }
+  return TryAllreduce(buf, elem_size, count, reducer);
 }
 
 void Comm::Broadcast(void* buf, size_t size, int root, const char*) {

@@ -54,11 +54,39 @@ class Comm {
   // replays a cached result.
   typedef void (*PrepareFn)(void*);
 
+  // Pluggable accelerator data plane: when registered, payload
+  // reductions with known (dtype, op) semantics and nbytes >=
+  // dataplane_minbytes_ execute through this callback (the XLA
+  // device-mesh collective) instead of the socket tree/ring; the socket
+  // path remains the control plane (consensus, replay, checkpoints) and
+  // the sub-threshold path — the host/device crossover SURVEY §7 calls
+  // out for small-message latency. ``epoch`` is the tracker's link
+  // (re)registration epoch: it advances exactly when the worker set was
+  // rewired, telling the callback to tear down and re-form its
+  // fixed-membership device world (XLA collectives cannot survive a
+  // membership change; the reference's socket substrate can,
+  // allreduce_robust.cc:602-613). Returns 0 on success; nonzero is
+  // treated like a link failure and enters recovery.
+  typedef int (*DataPlaneFn)(void* buf, uint64_t count, int dtype, int op,
+                             uint32_t epoch, void* ctx);
+  void SetDataPlane(DataPlaneFn fn, void* ctx, size_t min_bytes) {
+    dataplane_ = fn;
+    dataplane_ctx_ = ctx;
+    dataplane_minbytes_ = min_bytes;
+  }
+  uint32_t world_epoch() const { return world_epoch_; }
+  const std::string& coord_host() const { return coord_host_; }
+  int coord_port() const { return coord_port_; }
+
   // In-place elementwise allreduce (IEngine::Allreduce, engine.h:74-96).
+  // ``dtype``/``op`` are the C-ABI enum codes when known (runtime
+  // dispatch, capi.cc) or -1 for opaque custom reducers — only coded ops
+  // are eligible for the accelerator data plane.
   virtual void Allreduce(void* buf, size_t elem_size, size_t count,
                          ReduceFn reducer, PrepareFn prepare = nullptr,
                          void* prepare_arg = nullptr,
-                         const char* cache_key = "");
+                         const char* cache_key = "",
+                         int dtype = -1, int op = -1);
   // Broadcast size bytes from root into buf everywhere
   // (IEngine::Broadcast, engine.h:98-105).
   virtual void Broadcast(void* buf, size_t size, int root,
@@ -90,6 +118,13 @@ class Comm {
   void CloseLinks();
 
   // --- collectives (return NetResult for the recovery layer) ----------
+  // Dispatch one payload reduction: accelerator data plane when
+  // eligible (hook set, coded op, above crossover), else socket
+  // tree/ring. The single execute point the robust engine wraps — the
+  // role of the reference's virtual TryAllreduce dispatch
+  // (allreduce_robust.cc:159-219 wrapping allreduce_base.cc:457-463).
+  NetResult ExecuteAllreduce(void* buf, size_t elem_size, size_t count,
+                             ReduceFn reducer, int dtype, int op);
   NetResult TryAllreduce(void* buf, size_t elem_size, size_t count,
                          ReduceFn reducer);
   NetResult TryAllreduceTree(char* buf, size_t elem_size, size_t count,
@@ -118,6 +153,16 @@ class Comm {
   size_t ring_mincount_ = 32 << 10;   // reference default 32K elements
   size_t reduce_buffer_ = 256u << 20; // reference default 256MB
   bool debug_ = false;
+
+  // accelerator data plane (see SetDataPlane)
+  DataPlaneFn dataplane_ = nullptr;
+  void* dataplane_ctx_ = nullptr;
+  size_t dataplane_minbytes_ = 0;
+  // link (re)registration epoch + per-epoch device-world coordinator
+  // (rank 0's host and a fresh port), assigned by the tracker
+  uint32_t world_epoch_ = 0;
+  std::string coord_host_;
+  int coord_port_ = 0;
 
   Listener listener_;
   // One socket per distinct neighbor (tree parent/children and ring

@@ -66,7 +66,7 @@ class MpiComm : public Comm {
 
   void Allreduce(void* buf, size_t elem_size, size_t count, ReduceFn reducer,
                  PrepareFn prepare = nullptr, void* prepare_arg = nullptr,
-                 const char* = "") override {
+                 const char* = "", int = -1, int = -1) override {
     if (prepare) prepare(prepare_arg);
     if (world_ == 1 || count == 0) return;
     MPI_Datatype dtype;

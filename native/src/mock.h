@@ -49,10 +49,11 @@ class MockComm : public RobustComm {
 
   void Allreduce(void* buf, size_t elem_size, size_t count, ReduceFn reducer,
                  PrepareFn prepare = nullptr, void* prepare_arg = nullptr,
-                 const char* cache_key = "") override {
+                 const char* cache_key = "",
+                 int dtype = -1, int op = -1) override {
     double t0 = GetTime();
     RobustComm::Allreduce(buf, elem_size, count, reducer, prepare,
-                          prepare_arg, cache_key);
+                          prepare_arg, cache_key, dtype, op);
     collective_seconds_ += GetTime() - t0;
   }
 

@@ -187,7 +187,15 @@ void Listener::Bind(int port_start, int ntrial) {
     addr.sin_port = htons(static_cast<uint16_t>(p));
     if (::bind(fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
       RT_CHECK(::listen(fd_, 256) == 0, "listen failed");
-      port_ = p;
+      if (p == 0) {  // ephemeral: ask the kernel which port it picked
+        sockaddr_in got{};
+        socklen_t len = sizeof(got);
+        RT_CHECK(getsockname(fd_, reinterpret_cast<sockaddr*>(&got),
+                             &len) == 0, "getsockname failed");
+        port_ = ntohs(got.sin_port);
+      } else {
+        port_ = p;
+      }
       return;
     }
   }

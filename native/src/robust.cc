@@ -299,7 +299,8 @@ NetResult RobustComm::TryServeBootstrap(void* buf, size_t size, bool mine,
 
 void RobustComm::Allreduce(void* buf, size_t elem_size, size_t count,
                            ReduceFn reducer, PrepareFn prepare,
-                           void* prepare_arg, const char* cache_key) {
+                           void* prepare_arg, const char* cache_key,
+                           int dtype, int op) {
   OnEngineCall("allreduce");
   const size_t size = elem_size * count;
   if (world_ == 1) {
@@ -338,7 +339,11 @@ void RobustComm::Allreduce(void* buf, size_t elem_size, size_t count,
   double t0 = debug_ ? GetTime() : 0.0;
   std::string pristine(static_cast<char*>(buf), size);
   for (;;) {
-    NetResult res = TryAllreduce(buf, elem_size, count, reducer);
+    // execute step: accelerator data plane when eligible, socket
+    // tree/ring otherwise — the robust wrapper structure of the
+    // reference (allreduce_robust.cc:159-219 around TryAllreduce)
+    NetResult res = ExecuteAllreduce(buf, elem_size, count, reducer,
+                                     dtype, op);
     if (res == NetResult::kOk) {
       // per-op latency trace (reference rabit_debug logging,
       // allreduce_robust.cc:206-210,262-268)

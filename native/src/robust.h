@@ -29,7 +29,8 @@ class RobustComm : public Comm {
  public:
   void Allreduce(void* buf, size_t elem_size, size_t count, ReduceFn reducer,
                  PrepareFn prepare = nullptr, void* prepare_arg = nullptr,
-                 const char* cache_key = "") override;
+                 const char* cache_key = "",
+                 int dtype = -1, int op = -1) override;
   void Broadcast(void* buf, size_t size, int root,
                  const char* cache_key = "") override;
   int LoadCheckpoint(std::string* global, std::string* local) override;

@@ -81,6 +81,10 @@ def init(args: Optional[list] = None, engine: str = "auto", **kwargs) -> None:
             src/allreduce_mock.h).
           - ``"xla"``: JAX/XLA collectives over the device mesh (TPU-native
             data plane; no reference equivalent — this is the point).
+          - ``"robust_xla"``: the north-star composition — the C++
+            fault-tolerant control plane (consensus, replay, checkpoint
+            recovery) wrapped around the XLA device-mesh data plane;
+            equivalent to ``"robust"`` plus ``rabit_dataplane=xla``.
     """
     global _engine
     if _engine is not None:
@@ -108,6 +112,9 @@ def init(args: Optional[list] = None, engine: str = "auto", **kwargs) -> None:
         elif engine in ("native", "base", "robust", "mock"):
             from .engine.native import NativeEngine
             _engine = NativeEngine(variant=engine)
+        elif engine == "robust_xla":
+            from .engine.native import NativeEngine
+            _engine = NativeEngine(variant="robust", dataplane="xla")
         else:
             raise ValueError(f"unknown engine {engine!r}")
     except ImportError as e:

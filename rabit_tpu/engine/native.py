@@ -50,6 +50,8 @@ def _find_library() -> str:
 
 
 _PREPARE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+_RAW_REDUCE_CB = ctypes.CFUNCTYPE(None, ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_size_t, ctypes.c_void_p)
 
 
 def _load() -> ctypes.CDLL:
@@ -76,6 +78,16 @@ def _load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.POINTER(ctypes.c_char)),
         ctypes.POINTER(ctypes.c_uint64)]
     lib.RbtLoadCheckpoint.restype = ctypes.c_int
+    from .dataplane import DATAPLANE_CB
+    lib.RbtSetDataPlane.argtypes = [
+        DATAPLANE_CB, ctypes.c_void_p, ctypes.c_uint64]
+    lib.RbtWorldEpoch.restype = ctypes.c_int
+    lib.RbtCoordAddr.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t]
+    lib.RbtAllreduceRaw.argtypes = [
+        ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
+        _RAW_REDUCE_CB, ctypes.c_void_p, _PREPARE_CB, ctypes.c_void_p,
+        ctypes.c_char_p]
     return lib
 
 
@@ -91,11 +103,14 @@ def _caller_site(depth: int = 2) -> str:
 
 
 class NativeEngine(Engine):
-    def __init__(self, variant: str = "robust") -> None:
+    def __init__(self, variant: str = "robust",
+                 dataplane: Optional[str] = None) -> None:
         self._lib = _load()
         self._variant = variant
         self._key_counts: dict = {}
         self._loaded = False
+        self._dataplane_kind = dataplane
+        self._dataplane = None
 
     def _cache_key(self, site: str, size: int) -> bytes:
         """Deterministic replay key: caller site + payload size + an
@@ -124,8 +139,27 @@ class NativeEngine(Engine):
             argv.append(f"rabit_engine={self._variant}")
         arr = (ctypes.c_char_p * len(argv))(*[a.encode() for a in argv])
         self._check(self._lib.RbtInit(len(argv), arr), "init")
+        from ..utils.config import Config
+        cfg = Config.from_args(args)
+        kind = self._dataplane_kind or cfg.get("rabit_dataplane")
+        if kind == "xla" and self.is_distributed:
+            from .dataplane import XlaDataPlane
+            self._dataplane = XlaDataPlane(
+                self._lib,
+                init_timeout=cfg.get_int("rabit_dataplane_init_timeout", 60))
+            minbytes = cfg.get_size("rabit_dataplane_minbytes", 1024)
+            self._check(self._lib.RbtSetDataPlane(
+                self._dataplane.c_callback, None, minbytes),
+                "set_dataplane")
+        elif kind not in (None, "", "xla", "none"):
+            raise ValueError(f"unknown rabit_dataplane {kind!r}")
 
     def shutdown(self) -> None:
+        if self._dataplane is not None:
+            # reference-dropping teardown: no disconnect RPCs, so no
+            # ordering between ranks is needed (see dataplane.py)
+            self._dataplane.shutdown()
+            self._dataplane = None
         self._check(self._lib.RbtFinalize(), "finalize")
 
     def allreduce(self, buf: np.ndarray, op: int,

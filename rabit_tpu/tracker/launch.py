@@ -22,11 +22,18 @@ from .tracker import Tracker
 
 
 def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
-           timeout: float = 300.0, quiet: bool = False) -> int:
+           timeout: float = 300.0, quiet: bool = False,
+           coordinator: Optional[bool] = None) -> int:
     """Run ``cmd`` as ``nworkers`` local processes under a tracker.
     Returns 0 on success. Workers exiting nonzero are respawned with an
-    incremented attempt counter until ``max_attempts``."""
-    tracker = Tracker(nworkers).start()
+    incremented attempt counter until ``max_attempts``. ``coordinator``
+    makes the tracker host a per-epoch device-world coordination service
+    (required by the XLA data plane); default: auto-detect from the
+    worker command / environment."""
+    if coordinator is None:
+        coordinator = (os.environ.get("RABIT_DATAPLANE") == "xla"
+                       or any(a == "rabit_dataplane=xla" for a in cmd))
+    tracker = Tracker(nworkers, coordinator=coordinator).start()
     procs: Dict[int, subprocess.Popen] = {}
     attempts: Dict[int, int] = {i: 0 for i in range(nworkers)}
     finished: Dict[int, bool] = {i: False for i in range(nworkers)}

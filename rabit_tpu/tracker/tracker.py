@@ -13,11 +13,19 @@ Wire protocol (binary, little-endian, length-prefixed strings):
                      num_attempt u32
     start/recover: + host str, listen_port u32
     print:         + msg str
-  tracker -> worker (start/recover): rank u32, world u32, parent u32
-    (0xFFFFFFFF = none), ntree u32 + tree neighbor ranks, ring_prev u32,
-    ring_next u32, nconnect u32 + (peer_rank u32, host str, port u32)...,
-    naccept u32; worker replies ready u32 after wiring its links.
+  tracker -> worker (start/recover): rank u32, world u32, epoch u32,
+    coord_host str, coord_port u32 (this epoch's tracker-hosted device
+    -world coordination service; empty/0 when coordinator hosting is
+    off), parent u32 (0xFFFFFFFF = none), ntree u32 + tree neighbor
+    ranks, ring_prev u32, ring_next u32,
+    nconnect u32 + (peer_rank u32, host str, port u32)..., naccept u32;
+    worker replies ready u32 after wiring its links.
 Workers connect to lower-ranked neighbors and accept from higher ranks.
+The epoch counts completed registration batches: every live worker
+re-registers in the same batch during recovery, so all members of a
+batch observe the same epoch — the agreement the accelerator data plane
+needs to tear down/re-form its fixed-membership device world without an
+extra consensus round.
 """
 
 from __future__ import annotations
@@ -68,7 +76,8 @@ def tree_neighbors(rank: int, world: int) -> Tuple[Optional[int], List[int]]:
 
 
 class Tracker:
-    def __init__(self, nworkers: int, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, nworkers: int, host: str = "127.0.0.1", port: int = 0,
+                 coordinator: bool = False):
         self.nworkers = nworkers
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -84,6 +93,19 @@ class Tracker:
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.messages: List[str] = []
+        # device-world coordinator hosting (accelerator data plane): one
+        # JAX coordination service per registration epoch, living HERE —
+        # a service that vanishes under a live client fatally terminates
+        # that client's process (jaxlib error-poll thread), so services
+        # must be hosted by the one process guaranteed to outlive every
+        # worker: the tracker (the reference's tracker daemon plays the
+        # same always-alive role, SURVEY §2 #16). Failure detection is
+        # the socket control plane's job, so the services' own heartbeat
+        # policing is disabled (huge timeout) — a dead worker must not
+        # poison the survivors' agents.
+        self._coordinator = coordinator
+        self._services: List[object] = []       # keep alive until stop()
+        self._coord_addr: Tuple[str, int] = ("", 0)
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Tracker":
@@ -100,6 +122,28 @@ class Tracker:
             self.sock.close()
         except OSError:
             pass
+        # workers have exited (or been killed) by now, so no live client
+        # can be poisoned by its service going away
+        for svc in self._services:
+            try:
+                svc.shutdown()
+            except Exception:
+                pass
+        self._services.clear()
+
+    def _new_coordinator(self) -> Tuple[str, int]:
+        """Start this epoch's coordination service on a fresh port."""
+        from jax._src.lib import _jax
+        probe = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        probe.bind((self.host, 0))
+        port = probe.getsockname()[1]
+        probe.close()
+        svc = _jax.get_distributed_runtime_service(
+            f"[::]:{port}", self.nworkers,
+            heartbeat_timeout=1 << 20,  # failure detection is not its job
+            shutdown_timeout=1)
+        self._services.append(svc)
+        return (self.host, port)
 
     def env(self, task_id: str, num_attempt: int = 0) -> Dict[str, str]:
         """Environment for a worker process."""
@@ -176,19 +220,22 @@ class Tracker:
                 batch = dict(self._pending)
                 self._pending.clear()
                 self._epoch += 1
+                epoch = self._epoch
                 self._cv.notify_all()
                 # assignment happens outside the lock in this thread
             else:
                 self._cv.wait_for(
                     lambda: rank not in self._pending or self._done.is_set())
                 return  # the completing thread serves everyone
-        self._assign(batch)
+        self._assign(batch, epoch)
 
-    def _assign(self, batch: Dict[int, Tuple[socket.socket, str, int]]
-                ) -> None:
+    def _assign(self, batch: Dict[int, Tuple[socket.socket, str, int]],
+                epoch: int) -> None:
         world = self.nworkers
         addr = {r: (h, p) for r, (c, h, p) in batch.items()}
         conns = {r: c for r, (c, h, p) in batch.items()}
+        coord_host, coord_port = (self._new_coordinator()
+                                  if self._coordinator else ("", 0))
         for rank in sorted(batch):
             conn = conns[rank]
             parent, children = tree_neighbors(rank, world)
@@ -203,6 +250,9 @@ class Tracker:
             try:
                 _send_u32(conn, rank)
                 _send_u32(conn, world)
+                _send_u32(conn, epoch)
+                _send_str(conn, coord_host)
+                _send_u32(conn, coord_port)
                 _send_u32(conn, NO_RANK if parent is None else parent)
                 _send_u32(conn, len(tree_nbrs))
                 for r in tree_nbrs:

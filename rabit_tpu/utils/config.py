@@ -27,6 +27,8 @@ ENV_VARS = [
     "RABIT_BOOTSTRAP_CACHE",
     "RABIT_DEBUG",
     "RABIT_ENGINE",
+    "RABIT_DATAPLANE",
+    "RABIT_DATAPLANE_MINBYTES",
     "RABIT_WORLD_SIZE",
     "RABIT_RANK",
     "rabit_world_size",

@@ -1,0 +1,123 @@
+"""Fault-injection recovery with the XLA data plane — the north-star
+composition (BASELINE.json: AllreduceRobust recovery tests passing over
+TPU collectives; SURVEY §7 hard part #1).
+
+Every scenario from test_recovery.py re-runs with payload collectives
+executing on the device mesh (CPU backend + gloo here; ICI on real TPU)
+while the C++ host control plane keeps consensus, result replay,
+checkpoint recovery, and prepare-skip. The tracker hosts one device
+-world coordination service per link epoch; workers re-form their
+fixed-membership JAX world whenever the epoch advances (a recovery
+happened). ``rabit_dataplane_minbytes=0`` forces every coded-op payload
+through the device plane, so replay buffers, checkpoints, and the mock
+kill schedule are all exercised against device-produced results.
+"""
+
+import os
+
+import pytest
+
+from tests.test_integration import run_cluster, LIB
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isfile(LIB), reason="native core not built")
+
+XLA_ENV = {"RABIT_DATAPLANE": "xla", "RABIT_DATAPLANE_MINBYTES": "0"}
+ARGS = ["rabit_dataplane=xla", "rabit_dataplane_minbytes=0"]
+
+
+def run_xla(nworkers, worker, extra_args=(), env=None, timeout=240):
+    full_env = dict(XLA_ENV)
+    if env:
+        full_env.update(env)
+    return run_cluster(nworkers, worker, extra_args=list(extra_args) + ARGS,
+                       env=full_env, timeout=timeout)
+
+
+def test_no_failure_checkpoint_loop():
+    assert run_xla(4, "recover_worker.py") == 0
+
+
+def test_healthy_collectives_all_ops():
+    # every op x dtype pair of basic_worker through the device plane
+    assert run_xla(3, "basic_worker.py",
+                   env={"WORKER_ENGINE": "robust"}) == 0
+
+
+def test_single_death_at_first_iteration():
+    assert run_xla(4, "recover_worker.py",
+                   extra_args=["mock=0,0,0,0"]) == 0
+
+
+def test_single_death_mid_training():
+    assert run_xla(4, "recover_worker.py",
+                   extra_args=["mock=1,2,1,0"]) == 0
+
+
+def test_multiple_simultaneous_deaths():
+    assert run_xla(4, "recover_worker.py",
+                   extra_args=["mock=0,1,0,0", "mock=2,1,1,0"]) == 0
+
+
+def test_die_hard_same_rank_twice():
+    assert run_xla(4, "recover_worker.py",
+                   extra_args=["mock=1,1,1,0", "mock=1,1,1,1"]) == 0
+
+
+def test_death_at_load_checkpoint():
+    assert run_xla(4, "recover_worker.py",
+                   extra_args=["mock=3,0,0,0", "mock=3,0,0,1"]) == 0
+
+
+def test_local_checkpoint_recovery():
+    assert run_xla(4, "recover_worker.py",
+                   extra_args=["mock=2,2,0,0"],
+                   env={"WITH_LOCAL": "1"}) == 0
+
+
+def test_bootstrap_cache_recovery():
+    assert run_xla(4, "bootstrap_worker.py",
+                   extra_args=["rabit_bootstrap_cache=1",
+                               "mock=2,1,0,0"]) == 0
+
+
+def test_bootstrap_two_simultaneous_requesters():
+    assert run_xla(4, "bootstrap_worker.py",
+                   extra_args=["rabit_bootstrap_cache=1",
+                               "mock=1,1,0,0", "mock=2,1,0,0"]) == 0
+
+
+def test_force_local_reroute():
+    assert run_xla(4, "recover_worker.py",
+                   extra_args=["force_local=1", "mock=2,2,0,0"]) == 0
+
+
+def test_report_stats_smoke():
+    assert run_xla(2, "recover_worker.py",
+                   extra_args=["rabit_engine=mock", "report_stats=1"]) == 0
+
+
+def test_lazy_checkpoint_recovery():
+    assert run_xla(4, "recover_worker.py",
+                   extra_args=["mock=1,2,1,0"],
+                   env={"LAZY": "1"}) == 0
+
+
+def test_result_log_thinning_recovery():
+    assert run_xla(6, "recover_worker.py",
+                   extra_args=["rabit_global_replica=2",
+                               "mock=1,2,1,0"]) == 0
+
+
+def test_prepare_skipped_on_replay():
+    """XlaEngine.allreduce skips prepare_fun on replay: the respawned
+    rank's eagerly-cached op comes from the survivors' result logs, not
+    a re-execution (reference allreduce_robust.cc:191: prepare runs only
+    past RecoverExec)."""
+    assert run_xla(4, "prepare_skip_worker.py",
+                   extra_args=["mock=1,0,1,0"]) == 0
+
+
+def test_prepare_runs_fresh_without_failure():
+    # the same worker healthy: both prepares run everywhere
+    assert run_xla(3, "prepare_skip_worker.py") == 0

@@ -1,0 +1,68 @@
+"""Pins the prepare-skip contract on replay (reference engine.h:74-96:
+prepare_fun runs lazily and is skipped when the result is replayed from
+the recovery cache).
+
+Schedule: ``mock=1,0,1,0`` kills rank 1 at its SECOND collective
+(version 0, seq 1). On respawn (trial 1), rank 1 re-issues op seq 0 —
+the survivors hold its result in their logs, so the robust engine
+replays it and the prepare_fun must NOT run; then op seq 1 executes
+fresh and prepare MUST run. Works identically with the socket and XLA
+data planes.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if os.environ.get("RABIT_DATAPLANE") == "xla":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init()
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+    trial = int(os.environ.get("RABIT_NUM_TRIAL", "0"))
+    n = 64
+
+    version, model = rabit.load_checkpoint()
+
+    prep_calls = []
+
+    def prep(d):
+        prep_calls.append(True)
+        d[:] = np.arange(n, dtype=np.float32) + rank
+
+    # op seq 0: replayed on rank 1's respawn => prep skipped there
+    a = np.zeros(n, dtype=np.float32)
+    out = rabit.allreduce(a, rabit.MAX, prepare_fun=prep)
+    np.testing.assert_allclose(out, np.arange(n) + (world - 1))
+    if rank == 1 and trial > 0:
+        assert not prep_calls, \
+            "prepare_fun ran on a REPLAYED op (must be skipped)"
+    else:
+        assert prep_calls, "prepare_fun did not run on a fresh op"
+
+    # op seq 1: the respawned rank's first fresh op => prep must run
+    # (the mock kills rank 1 here on trial 0)
+    prep_calls.clear()
+    b = np.zeros(n, dtype=np.float32)
+    out = rabit.allreduce(b, rabit.MAX, prepare_fun=prep)
+    np.testing.assert_allclose(out, np.arange(n) + (world - 1))
+    assert prep_calls, "prepare_fun did not run on a fresh op"
+
+    rabit.checkpoint({"done": True})
+    rabit.tracker_print(f"prepare_skip_worker rank {rank}/{world} OK "
+                        f"(trial {trial})")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,13 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
 
+if os.environ.get("RABIT_DATAPLANE") == "xla":
+    # tests drive the device plane on the CPU backend (gloo); must be
+    # configured before any computation touches the default backend
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
 import numpy as np  # noqa: E402
 import rabit_tpu as rabit  # noqa: E402
 
