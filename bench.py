@@ -18,11 +18,14 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 from __future__ import annotations
 
+import datetime
 import json
+import os
 import sys
 import time
 
-sys.path.insert(0, __file__.rsplit("/", 1)[0])
+_REPO = __file__.rsplit("/", 1)[0]
+sys.path.insert(0, _REPO)
 
 
 ITERS = 24  # amortizes the ~10 ms/dispatch tunnel floor
@@ -53,10 +56,13 @@ def _bench(fn, combine):
     return best
 
 
-def _probe_device(timeout_s: float = 90.0) -> None:
-    """Fail fast if the device is unreachable: the tunnelled TPU
-    occasionally goes down entirely, hanging even trivial dispatches.
-    Better to exit with a clear error than hang the driver's bench run."""
+def _probe_once(timeout_s: float) -> str:
+    """One device-reachability probe. Returns "" on success, else an
+    error description. The tunnelled TPU occasionally goes down entirely,
+    hanging even trivial dispatches, so the dispatch runs on a daemon
+    thread we can abandon. A hung dispatch leaves that thread wedged in
+    the runtime — harmless for the probe (each attempt uses a fresh
+    thread; success only needs one attempt to complete)."""
     import threading
     ok = threading.Event()
     err: list = []
@@ -65,9 +71,12 @@ def _probe_device(timeout_s: float = 90.0) -> None:
         try:
             import jax.numpy as jnp
             import numpy as np
-            np.asarray(jnp.ones((8,)).sum())
+            # fresh constant each attempt: the tunnel memoizes
+            # (executable, inputs) -> result, and a memo hit would
+            # "succeed" without touching the device
+            np.asarray((jnp.ones((8,)) * float(time.time() % 1e4)).sum())
             ok.set()
-        except BaseException as e:  # noqa: BLE001 — re-raised below
+        except BaseException as e:  # noqa: BLE001 — reported below
             err.append(e)
             ok.set()
 
@@ -75,11 +84,58 @@ def _probe_device(timeout_s: float = 90.0) -> None:
     t.start()
     ok.wait(timeout_s)
     if err:
-        raise RuntimeError(f"device probe failed: {err[0]!r}") from err[0]
+        return f"device probe failed: {err[0]!r}"
     if not ok.is_set():
-        raise RuntimeError(
-            f"device unreachable: a trivial dispatch did not complete in "
-            f"{timeout_s:.0f}s (TPU tunnel down?)")
+        return (f"dispatch did not complete in {timeout_s:.0f}s "
+                f"(TPU tunnel down?)")
+    return ""
+
+
+def _probe_device() -> None:
+    """Wait for the device with retry/backoff instead of one-shot
+    fail-fast: the tunnel's outages are transient (minutes-scale), and a
+    bench run that gives up after one probe loses the round's only
+    driver-captured perf evidence. Budget/backoff via
+    RABIT_BENCH_PROBE_BUDGET_S (default 1800) — probes every 60s
+    doubling to 300s until the budget is spent, then fails loudly."""
+    budget = float(os.environ.get("RABIT_BENCH_PROBE_BUDGET_S", "1800"))
+    deadline = time.monotonic() + budget
+    interval, attempt = 60.0, 0
+    while True:
+        attempt += 1
+        msg = _probe_once(timeout_s=90.0)
+        if not msg:
+            if attempt > 1:
+                print(f"# device reachable after {attempt} probes",
+                      file=sys.stderr, flush=True)
+            return
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise RuntimeError(
+                f"device unreachable after {attempt} probes over "
+                f"{budget:.0f}s: {msg}")
+        print(f"# probe {attempt} failed ({msg}); retrying in "
+              f"{min(interval, remaining):.0f}s "
+              f"({remaining:.0f}s budget left)", file=sys.stderr, flush=True)
+        time.sleep(min(interval, max(remaining, 1.0)))
+        interval = min(interval * 2, 300.0)
+
+
+def _write_local_artifact(payload: dict) -> None:
+    """Persist perf evidence in-repo the moment a run succeeds, so a
+    tunnel outage at the driver's capture time cannot zero the round's
+    evidence (VERDICT r2 gap #1). One timestamped file per successful
+    run; committed with the round's work."""
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ")
+    path = os.path.join(_REPO, f"BENCH_LOCAL_{ts}.json")
+    try:
+        with open(path, "w") as f:
+            json.dump(dict(payload, timestamp_utc=ts), f, indent=1)
+            f.write("\n")
+        print(f"# wrote {path}", file=sys.stderr, flush=True)
+    except OSError as e:  # pragma: no cover - artifact is best-effort
+        print(f"# artifact write failed: {e}", file=sys.stderr, flush=True)
 
 
 def main() -> None:
@@ -107,13 +163,13 @@ def main() -> None:
     jax.block_until_ready(dev_sets)
     grad, hess, bins = host_sets[0]
 
-    def run(method, i=0):
+    def run(method, i=0, precision="fast"):
         g, h, b = dev_sets[i % nsets]
-        # precision pinned explicitly: the bench times the documented
-        # fast path (bf16 dot, ~2e-4 rel err — checked below); the
-        # library default is "high"
+        # headline times the documented fast path (bf16 dot, ~2e-4 rel
+        # err — checked below); the library-default "high" path is
+        # measured alongside and recorded in the artifact
         return H.distributed_histogram(g, h, b, nbins, mesh, "workers",
-                                       method, precision="fast")
+                                       method, precision=precision)
 
     import jax.numpy as jnp
 
@@ -132,6 +188,15 @@ def main() -> None:
             f"all benchmark methods {methods} failed; see stderr above")
     best_method = min(results, key=results.get)
     t_dev = results[best_method]
+
+    # library-default precision path, same best method (artifact only)
+    t_high = None
+    try:
+        t_high = _bench(
+            lambda i: run(best_method, i, precision="high"),
+            lambda outs: jnp.stack(outs).sum(0))
+    except Exception as e:  # pragma: no cover
+        print(f"# high-precision run failed: {e}", file=sys.stderr)
 
     nbytes = p * n * 12  # grad f32 + hess f32 + bins i32 per row
     dev_gbps = nbytes / t_dev / 1e9
@@ -158,15 +223,27 @@ def main() -> None:
     atol = 8 * 2.0 ** -9 * float(np.sqrt(p * n / nbins))
     ok = np.allclose(got, want, rtol=2e-2, atol=atol)
 
+    high_note = f"t_high={t_high*1e3:.2f}ms " if t_high else ""
     print(f"# devices={p} n/worker={n} nbins={nbins} "
-          f"method={best_method} t_dev={t_dev*1e3:.2f}ms "
+          f"method={best_method} t_dev={t_dev*1e3:.2f}ms {high_note}"
           f"t_host={t_host*1e3:.2f}ms correct={ok}", file=sys.stderr)
-    print(json.dumps({
+    line = {
         "metric": "histogram_allreduce_throughput",
         "value": round(dev_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(dev_gbps / host_gbps, 3),
-    }))
+    }
+    _write_local_artifact(dict(
+        line,
+        backend=jax.default_backend(),
+        devices=p, rows_per_worker=n, nbins=nbins,
+        method=best_method,
+        t_dev_ms={m: round(t * 1e3, 3) for m, t in results.items()},
+        t_high_ms=round(t_high * 1e3, 3) if t_high else None,
+        high_gbps=round(nbytes / t_high / 1e9, 3) if t_high else None,
+        t_host_ms=round(t_host * 1e3, 3),
+        correct=bool(ok)))
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":

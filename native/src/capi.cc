@@ -111,13 +111,21 @@ int RbtTrackerPrint(const char* msg) {
   RT_API_END();
 }
 
+// copy s into (buf, max_len) always NUL-terminated; *len reports the
+// full untruncated length so callers can detect truncation
+static void CopyCStr(const std::string& s, char* buf, size_t* len,
+                     size_t max_len) {
+  if (max_len > 0) {
+    size_t n = s.size() < max_len - 1 ? s.size() : max_len - 1;
+    memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  *len = s.size();
+}
+
 int RbtGetProcessorName(char* buf, size_t* len, size_t max_len) {
   RT_API_BEGIN();
-  const std::string& h = GetComm()->host();
-  size_t n = h.size() < max_len ? h.size() : max_len;
-  memcpy(buf, h.data(), n);
-  if (n < max_len) buf[n] = '\0';
-  *len = h.size();
+  CopyCStr(GetComm()->host(), buf, len, max_len);
   RT_API_END();
 }
 
@@ -151,10 +159,7 @@ int RbtCoordAddr(char* buf, size_t* len, size_t max_len) {
   RT_API_BEGIN();
   std::string addr = GetComm()->coord_host() + ":" +
                      std::to_string(GetComm()->coord_port());
-  size_t n = addr.size() < max_len ? addr.size() : max_len;
-  memcpy(buf, addr.data(), n);
-  if (n < max_len) buf[n] = '\0';
-  *len = addr.size();
+  CopyCStr(addr, buf, len, max_len);
   RT_API_END();
 }
 

@@ -510,12 +510,22 @@ NetResult Comm::TryAllreduceRing(char* buf, size_t elem_size, size_t count,
 // ---------------------------------------------------------------------------
 
 static std::unique_ptr<Comm>& CommSlot() {
-  static std::unique_ptr<Comm> slot;
+  // per-thread engine store (reference ThreadLocalStore + EngineThreadLocal,
+  // engine.cc:33-43): each thread owns an independent engine slot; the
+  // engine itself remains documented not-thread-safe.
+  thread_local std::unique_ptr<Comm> slot;
   return slot;
 }
 
 Comm* GetComm() {
-  RT_CHECK(CommSlot() != nullptr, "rabit_tpu native engine not initialized");
+  if (CommSlot() == nullptr) {
+    // Pre-Init fallback (reference engine.cc:74-85): an un-initialized
+    // base engine so rank-0/world-1 topology queries — and world-1 no-op
+    // collectives — work before Init, matching the reference's static
+    // AllreduceBase default manager.
+    thread_local Comm fallback;
+    return &fallback;
+  }
   return CommSlot().get();
 }
 

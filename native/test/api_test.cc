@@ -138,6 +138,14 @@ static void TestCustomReducers() {
 }
 
 int main(int argc, char* argv[]) {
+  // pre-Init topology queries hit the rank-0/world-1 fallback engine
+  // (reference engine.cc:74-85: GetEngine returns a static
+  // un-initialized manager before Init)
+  CHECK(rabit::GetRank() == 0);
+  CHECK(rabit::GetWorldSize() == 1);
+  CHECK(!rabit::IsDistributed());
+  CHECK(rabit::VersionNumber() == 0);
+
   rabit::Init(argc, argv);
   CHECK(rabit::GetRank() == 0);
   CHECK(rabit::GetWorldSize() == 1);

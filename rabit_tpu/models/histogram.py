@@ -25,7 +25,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.reducers import SUM
 from ..parallel.collectives import (
-    shard_map, tree_allreduce, ring_allreduce, RING_MINCOUNT_DEFAULT)
+    shard_map, unchecked_shard_map, tree_allreduce, ring_allreduce,
+    RING_MINCOUNT_DEFAULT)
 
 
 def local_histogram(grad: jax.Array, hess: jax.Array, bins: jax.Array,
@@ -95,18 +96,21 @@ def distributed_histogram(grad, hess, bins, nbins: int, mesh: Mesh,
     replicated — the allreduced histogram every worker needs to find the
     best split.
     """
+    use_ring = nbins * 2 >= RING_MINCOUNT_DEFAULT
+
     def per_shard(g, h, b):
         hist = local_histogram(g[0], h[0], b[0], nbins, method, precision)
         flat = hist.reshape(-1)
-        if flat.size >= RING_MINCOUNT_DEFAULT:
-            red = ring_allreduce(flat, axis, SUM)
-        else:
-            red = tree_allreduce(flat, axis, SUM)
+        red = (ring_allreduce if use_ring else tree_allreduce)(
+            flat, axis, SUM)
         return red.reshape(hist.shape)
 
-    return shard_map(per_shard, mesh=mesh,
-                     in_specs=(P(axis), P(axis), P(axis)),
-                     out_specs=P())(grad, hess, bins)
+    # ring bodies need the replication checker off (ppermute chain); the
+    # psum path runs fully checked
+    sm = unchecked_shard_map if use_ring else shard_map
+    return sm(per_shard, mesh=mesh,
+              in_specs=(P(axis), P(axis), P(axis)),
+              out_specs=P())(grad, hess, bins)
 
 
 def host_histogram(grad: np.ndarray, hess: np.ndarray, bins: np.ndarray,

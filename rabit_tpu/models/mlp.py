@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.reducers import SUM
 from ..parallel.collectives import (
-    ring_allreduce, shard_map, psum_identity_grad)
+    ring_allreduce, shard_map, unchecked_shard_map, psum_identity_grad)
 
 Params = Dict[str, jax.Array]
 
@@ -52,16 +52,21 @@ def forward(params: Params, x: jax.Array) -> jax.Array:
                    preferred_element_type=jnp.float32) + params["b2"]
 
 
-def _local_loss(p: Params, x: jax.Array, y: jax.Array, tp_axis: str
-                ) -> jax.Array:
+def _local_loss(p: Params, x: jax.Array, y: jax.Array, tp_axis: str,
+                checked: bool = True) -> jax.Array:
     """Per-shard loss: x is the local dp batch shard, params are the local
-    tp shards; partial hidden products are combined with psum over tp."""
+    tp shards; partial hidden products are combined with psum over tp.
+    ``checked``: under the replication checker plain ``lax.psum`` is
+    gradient-correct (its transpose is a vma cast); unchecked contexts
+    need ``psum_identity_grad`` to avoid the double-psum transpose."""
     h = jax.nn.relu(
         jnp.dot(x.astype(jnp.bfloat16), p["w1"].astype(jnp.bfloat16),
                 preferred_element_type=jnp.float32) + p["b1"])
     partial = jnp.dot(h.astype(jnp.bfloat16), p["w2"].astype(jnp.bfloat16),
                       preferred_element_type=jnp.float32)
-    logits = psum_identity_grad(partial, tp_axis) + p["b2"]
+    combined = (lax.psum(partial, tp_axis) if checked
+                else psum_identity_grad(partial, tp_axis))
+    logits = combined + p["b2"]
     logp = jax.nn.log_softmax(logits)
     return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
 
@@ -72,33 +77,46 @@ def param_specs() -> Dict[str, P]:
             "w2": P("tp", None), "b2": P()}
 
 
-def make_train_step(mesh: Mesh, lr: float = 0.1):
+def make_train_step(mesh: Mesh, lr: float = 0.1, grad_sync: str = "psum"):
     """Build the jitted SPMD train step: (params, x, y) -> (params, loss).
 
-    Gradients are averaged over dp with this library's ring allreduce —
-    the explicit ppermute pipeline — rather than a bare psum, so the
-    flagship exercises the same collective the engine uses.
+    ``grad_sync="psum"`` (default): dp gradients sync with ``lax.psum``
+    and the step compiles with the replication checker ON.
+    ``grad_sync="ring"``: dp gradients go through this library's explicit
+    ppermute ring allreduce (the engine-parity collective); the ring
+    chain defeats the static checker, so the step compiles unchecked
+    with the conjugate-pair TP operator pinning gradient correctness.
     """
+    if grad_sync not in ("psum", "ring"):
+        raise ValueError(f"grad_sync must be 'psum' or 'ring', "
+                         f"got {grad_sync!r}")
     specs = param_specs()
     dp = mesh.shape["dp"]
+    checked = grad_sync == "psum"
 
     def per_shard(p: Params, x: jax.Array, y: jax.Array):
-        loss, grads = jax.value_and_grad(_local_loss)(p, x, y, "tp")
+        loss, grads = jax.value_and_grad(_local_loss)(p, x, y, "tp", checked)
 
         def sync(g):
-            flat = g.reshape(-1)
-            red = ring_allreduce(flat, "dp", SUM)
-            return red.reshape(g.shape) / dp
+            if grad_sync == "ring":
+                flat = g.reshape(-1)
+                red = ring_allreduce(flat, "dp", SUM)
+                return red.reshape(g.shape) / dp
+            # checked mode: params are invarying over dp, so autodiff has
+            # already dp-summed their cotangents (the automatic
+            # replicated->varying cast transposes to psum) — only the
+            # mean scaling remains
+            return g / dp
 
         grads = jax.tree_util.tree_map(sync, grads)
         new_p = jax.tree_util.tree_map(lambda w, g: w - lr * g, p, grads)
         loss = lax.psum(loss, "dp") / dp
         return new_p, loss
 
-    step = shard_map(
-        per_shard, mesh=mesh,
-        in_specs=(specs, P("dp", None), P("dp")),
-        out_specs=(specs, P()))
+    sm = shard_map if checked else unchecked_shard_map
+    step = sm(per_shard, mesh=mesh,
+              in_specs=(specs, P("dp", None), P("dp")),
+              out_specs=(specs, P()))
     return jax.jit(step)
 
 

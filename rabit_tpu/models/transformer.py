@@ -35,7 +35,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.collectives import (
-    ring_allreduce, shard_map, psum_identity_grad, ident_psum_grad)
+    ring_allreduce, shard_map, unchecked_shard_map, psum_identity_grad,
+    ident_psum_grad)
 from ..parallel.ring_attention import ring_attention, reference_attention
 
 Params = Dict[str, jax.Array]
@@ -136,65 +137,99 @@ def forward_reference(params: Params, tokens: jax.Array) -> jax.Array:
 
 
 def _shard_forward(params: Params, tokens: jax.Array, sp_axis: str,
-                   tp_axis: str) -> jax.Array:
-    """Per-shard forward: tokens [B_loc, T_loc]; params local tp shards."""
+                   tp_axis: str, checked: bool = True) -> jax.Array:
+    """Per-shard forward: tokens [B_loc, T_loc]; params local tp shards.
+
+    ``checked=True`` (replication checker on): tensor-parallel regions
+    use plain ``lax.psum`` — under jax's varying-manual-axes semantics
+    psum's transpose is a vma cast (identity values) and the automatic
+    replicated->varying casts transpose to psum, so the Megatron f/g
+    bookkeeping happens in the autodiff system itself. ``checked=False``
+    (ppermute-ring contexts, checker off): vma is not tracked, psum's
+    transpose double-counts, and the explicit conjugate pair
+    ``ident_psum_grad``/``psum_identity_grad`` pins correct gradients."""
     t_loc = tokens.shape[1]
     pos_ids = lax.axis_index(sp_axis) * t_loc + jnp.arange(t_loc)
     attn = jax.vmap(functools.partial(
         ring_attention, axis_name=sp_axis, causal=True))
-    enter = functools.partial(ident_psum_grad, axis_name=tp_axis)
-    combine = functools.partial(psum_identity_grad, axis_name=tp_axis)
+    if checked:
+        enter = lambda x: x  # noqa: E731
+        combine = lambda x: lax.psum(x, tp_axis)  # noqa: E731
+    else:
+        enter = functools.partial(ident_psum_grad, axis_name=tp_axis)
+        combine = functools.partial(psum_identity_grad, axis_name=tp_axis)
     return _forward(params, tokens, pos_ids, attn, enter, combine)
 
 
 def _local_loss(params: Params, tokens: jax.Array, targets: jax.Array,
-                sp_axis: str, tp_axis: str, dp_axis: str) -> jax.Array:
+                sp_axis: str, tp_axis: str, dp_axis: str,
+                checked: bool = True) -> jax.Array:
     """This rank's *partial* of the global mean NLL: local nll sum over
     the global token count. Kept local (no psum) so ``jax.grad`` yields
     exactly this rank's contribution — psum-ing the loss before grad
-    would inflate cotangents by dp*sp through the psum transpose. The
+    would inflate cotangents by dp*sp through the psum transpose (in
+    unchecked mode; vma-checked mode tracks this correctly but the
+    partial-loss formulation works identically under both). The
     replicated global loss is ``psum`` of this over (dp, sp)."""
-    logits = _shard_forward(params, tokens, sp_axis, tp_axis)
+    logits = _shard_forward(params, tokens, sp_axis, tp_axis, checked)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1).sum()
     count = tokens.size * lax.psum(1, (dp_axis, sp_axis))
     return nll / count
 
 
-def make_train_step(mesh: Mesh, lr: float = 0.1):
+def make_train_step(mesh: Mesh, lr: float = 0.1, grad_sync: str = "psum"):
     """Jitted SGD step over the (dp, tp, sp) mesh.
 
     ``step(params, tokens, targets) -> (new_params, loss)`` with tokens /
     targets [B, T] sharded P(dp, sp) and params laid out per
-    ``param_specs``. Gradient synchronization over dp uses this library's
-    ring allreduce; sp partial gradients are psum-reduced (tp gradients
-    are already local to each shard).
+    ``param_specs``.
+
+    ``grad_sync="psum"`` (default): dp gradient sync via ``lax.psum``
+    and the step compiles with the replication checker ON — XLA lowers
+    psum to its torus-optimal allreduce on ICI.
+    ``grad_sync="ring"``: dp sync through this library's explicit
+    ppermute ring allreduce (the engine-parity path); ring chains defeat
+    the static checker, so the step compiles unchecked with the
+    conjugate-pair TP operators pinning gradient correctness.
     """
+    if grad_sync not in ("psum", "ring"):
+        raise ValueError(f"grad_sync must be 'psum' or 'ring', "
+                         f"got {grad_sync!r}")
     dp_axis, tp_axis, sp_axis = mesh.axis_names
+    checked = grad_sync == "psum"
 
     def per_shard(params, tokens, targets):
         partial, grads = jax.value_and_grad(_local_loss)(
-            params, tokens, targets, sp_axis, tp_axis, dp_axis)
+            params, tokens, targets, sp_axis, tp_axis, dp_axis, checked)
         loss = lax.psum(partial, (dp_axis, sp_axis))
 
         def sync(g):
-            g = lax.psum(g, sp_axis)                     # sum sp partials
-            flat = g.reshape(-1)
-            flat = ring_allreduce(flat, dp_axis)          # sum dp partials
-            return flat.reshape(g.shape)
+            if grad_sync == "ring":
+                g = lax.psum(g, sp_axis)                  # sum sp partials
+                flat = g.reshape(-1)
+                flat = ring_allreduce(flat, dp_axis)      # sum dp partials
+                return flat.reshape(g.shape)
+            # checked mode: params are invarying over (dp, sp), so
+            # autodiff already summed their cotangents over both axes
+            # via the automatic replicated->varying cast transposes;
+            # _local_loss divides by the global token count, so the
+            # summed cotangent IS the global-mean gradient
+            return g
 
         grads = jax.tree.map(sync, grads)
         new_params = jax.tree.map(
             lambda p, g: (p - lr * g).astype(p.dtype), params, grads)
         return new_params, loss
 
+    sm = shard_map if checked else unchecked_shard_map
+
     @jax.jit
     def step(params, tokens, targets):
         specs = param_specs(params)
-        f = shard_map(
-            per_shard, mesh=mesh,
-            in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
-            out_specs=(specs, P()))
+        f = sm(per_shard, mesh=mesh,
+               in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
+               out_specs=(specs, P()))
         return f(params, tokens, targets)
 
     return step

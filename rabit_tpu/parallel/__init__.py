@@ -16,6 +16,7 @@ from .collectives import (  # noqa: F401
     tree_allreduce, bcast_from_root,
     device_allreduce, device_broadcast, RING_MINCOUNT_DEFAULT,
     psum_identity_grad, ident_psum_grad,
+    shard_map, unchecked_shard_map,
 )
 from .ring_attention import (  # noqa: F401
     ring_attention, ulysses_attention, sequence_parallel_attention,

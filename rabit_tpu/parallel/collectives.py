@@ -41,15 +41,28 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _shard_map
 import inspect as _inspect
 
-# The ring collectives produce replicated outputs through ppermute chains,
-# which the shard_map varying-manual-axes checker cannot infer statically;
-# disable the check (param renamed check_rep -> check_vma across jax
-# versions).
+# param renamed check_rep -> check_vma across jax versions
 _CHECK_KW = ("check_vma" if "check_vma" in
              _inspect.signature(_shard_map).parameters else "check_rep")
 
 
 def shard_map(f, **kwargs):
+    """``jax.shard_map`` with the varying-manual-axes (replication)
+    checker ON — the default for every sharded program in this library.
+    The checker statically verifies that values declared replicated
+    (``P()`` out_specs) really are, catching the double-psum bug class
+    ``psum_identity_grad``'s docstring describes."""
+    kwargs.setdefault(_CHECK_KW, True)
+    return _shard_map(f, **kwargs)
+
+
+def unchecked_shard_map(f, **kwargs):
+    """``jax.shard_map`` with the replication checker OFF — for bodies
+    built on ppermute ring chains (``ring_*`` collectives, ring
+    attention, pipeline stages): their outputs are replicated by
+    protocol, which the static checker cannot infer through a ppermute
+    chain. Scope of use is exactly those bodies; everything else goes
+    through :func:`shard_map`."""
     kwargs.setdefault(_CHECK_KW, False)
     return _shard_map(f, **kwargs)
 
@@ -155,15 +168,20 @@ def tree_allreduce(x: jax.Array, axis_name: str, op: int = SUM) -> jax.Array:
 
 
 def psum_identity_grad(x: jax.Array, axis_name: str) -> jax.Array:
-    """``lax.psum`` whose backward pass is the identity.
+    """``lax.psum`` whose backward pass is the identity — for
+    ``check_vma=False`` (unchecked) shard_map contexts ONLY.
 
     For model-parallel partial-sum reductions (e.g. combining
     tensor-parallel matmul partials) the mathematically correct cotangent
     of each partial is the (replicated) cotangent of the sum. Under
-    ``check_vma=False`` shard_map, ``lax.psum``'s transpose rule applies
-    a *second* psum to the already-replicated cotangent, scaling
-    upstream gradients by the axis size; this wrapper pins the correct
-    identity backward.
+    unchecked shard_map, ``lax.psum``'s transpose rule applies a
+    *second* psum to the already-replicated cotangent, scaling upstream
+    gradients by the axis size; this wrapper pins the correct identity
+    backward. Under ``check_vma=True`` plain ``lax.psum`` is already
+    gradient-correct (its transpose is a vma cast, and the automatic
+    replicated->varying casts transpose to psum) — use it directly
+    there; composing THIS op with the checker's automatic casts
+    double-counts the other way.
     """
     @jax.custom_vjp
     def f(v):
@@ -176,7 +194,8 @@ def psum_identity_grad(x: jax.Array, axis_name: str) -> jax.Array:
 
 def ident_psum_grad(x: jax.Array, axis_name: str) -> jax.Array:
     """Identity whose backward pass is ``lax.psum`` over ``axis_name`` —
-    the conjugate of :func:`psum_identity_grad`.
+    the conjugate of :func:`psum_identity_grad`, for unchecked shard_map
+    contexts only (see that function's note on ``check_vma=True``).
 
     Place it where a replicated activation *enters* a model-parallel
     region (before einsums with axis-sharded weights): each shard's
@@ -199,13 +218,15 @@ def ident_psum_grad(x: jax.Array, axis_name: str) -> jax.Array:
 def bcast_from_root(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
     """Broadcast rank ``root``'s value to all ranks (TryBroadcast,
     allreduce_base.cc:649-737): mask non-root contributions to the
-    additive identity and psum."""
+    additive identity and psum — vma-correct under the replication
+    checker (psum of a varying value is replicated). ``lax.pbroadcast``
+    (the CollectiveBroadcast HLO) would be the direct lowering but its
+    vma inference is not wired in this jax ("unbound axis name" under
+    shard_map); XLA pattern-matches select+allreduce anyway."""
     idx = lax.axis_index(axis_name)
     contrib = jnp.where(idx == root, x, jnp.zeros_like(x))
-    if jnp.issubdtype(x.dtype, jnp.integer) or x.dtype == jnp.bool_:
-        # psum on small ints is exact; bool promotes through int32
-        return lax.psum(contrib.astype(jnp.int32), axis_name).astype(x.dtype) \
-            if x.dtype == jnp.bool_ else lax.psum(contrib, axis_name)
+    if x.dtype == jnp.bool_:
+        return lax.psum(contrib.astype(jnp.int32), axis_name).astype(x.dtype)
     return lax.psum(contrib, axis_name)
 
 
@@ -224,8 +245,12 @@ def _allreduce_global(xs, mesh: Mesh, axis: str, op: int, method: str):
         else:
             red = tree_allreduce(flat, axis, op)
         return red.reshape(x.shape)
-    f = shard_map(per_shard, mesh=mesh,
-                  in_specs=P(axis), out_specs=P())
+    # ring bodies are ppermute chains — and the BitOR tree body is an
+    # all_gather + local fold — whose replicated outputs the static
+    # checker cannot infer; the psum/pmax/pmin tree path is fully checked
+    sm = (unchecked_shard_map if method == "ring" or op == BITOR
+          else shard_map)
+    f = sm(per_shard, mesh=mesh, in_specs=P(axis), out_specs=P())
     return f(xs)
 
 

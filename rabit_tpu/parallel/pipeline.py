@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .collectives import shard_map, _ring_perm
+from .collectives import unchecked_shard_map, _ring_perm
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_micro: jax.Array,
@@ -112,7 +112,7 @@ def make_pipeline_fn(mesh: Mesh, stage_fn: Callable,
                 f"one stage per rank: {n_stages} stages != axis "
                 f"'{axis}' size {pp}")
         specs = jax.tree.map(lambda _: P(axis), stacked_params)
-        f = shard_map(per_shard, mesh=mesh,
+        f = unchecked_shard_map(per_shard, mesh=mesh,
                       in_specs=(specs, P()), out_specs=P())
         return f(stacked_params, x_micro)
 

@@ -49,7 +49,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .collectives import shard_map, _ring_perm
+from .collectives import unchecked_shard_map, _ring_perm
 from ..ops.pallas_kernels import NEG_INF as _NEG_INF  # shared masking const
 
 
@@ -209,7 +209,7 @@ def _sp_attention(q, k, v, mesh: Mesh, axis: str, causal: bool, impl: str,
     else:
         per_shard = functools.partial(ulysses_attention, axis_name=axis,
                                       causal=causal)
-    f = shard_map(per_shard, mesh=mesh,
+    f = unchecked_shard_map(per_shard, mesh=mesh,
                   in_specs=(P(axis), P(axis), P(axis)), out_specs=P(axis))
     return f(q, k, v)
 
