@@ -69,14 +69,18 @@ def local_histogram(grad: jax.Array, hess: jax.Array, bins: jax.Array,
         bins_c = bins.reshape(-1, chunk)
         gh_c = gh.reshape(-1, chunk, 2)
 
-        def body(acc, xs):
-            b, g = xs
+        def chunk_hist(b, g):
             onehot = jax.nn.one_hot(b, nbins, dtype=jnp.bfloat16)
-            return acc + jnp.dot(onehot.T, g.astype(jnp.bfloat16),
-                                 preferred_element_type=jnp.float32), None
+            return jnp.dot(onehot.T, g.astype(jnp.bfloat16),
+                           preferred_element_type=jnp.float32)
 
+        def body(acc, xs):
+            return acc + chunk_hist(*xs), None
+
+        # seed the carry with chunk 0 (not plain zeros) so it carries the
+        # same varying-manual-axes as the data under a checked shard_map
         hist, _ = jax.lax.scan(
-            body, jnp.zeros((nbins, 2), jnp.float32), (bins_c, gh_c))
+            body, chunk_hist(bins_c[0], gh_c[0]), (bins_c[1:], gh_c[1:]))
         return hist
     if method == "scatter":
         return jax.ops.segment_sum(gh, bins, num_segments=nbins)
@@ -105,9 +109,16 @@ def distributed_histogram(grad, hess, bins, nbins: int, mesh: Mesh,
             flat, axis, SUM)
         return red.reshape(hist.shape)
 
-    # ring bodies need the replication checker off (ppermute chain); the
-    # psum path runs fully checked
-    sm = unchecked_shard_map if use_ring else shard_map
+    # ring bodies need the replication checker off (ppermute chain), and
+    # so does the pallas kernel (pallas_call's interpret evaluator is
+    # vma-inconsistent across its trace passes); matmul/scatter over the
+    # psum tree run fully checked
+    resolved = method
+    if method == "auto":
+        from ..ops.pallas_kernels import pallas_available
+        resolved = "pallas" if pallas_available() else "scatter"
+    sm = (unchecked_shard_map if use_ring or resolved == "pallas"
+          else shard_map)
     return sm(per_shard, mesh=mesh,
               in_specs=(P(axis), P(axis), P(axis)),
               out_specs=P())(grad, hess, bins)

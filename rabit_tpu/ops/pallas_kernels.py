@@ -32,6 +32,24 @@ import jax.numpy as jnp
 _CHUNK = 8192
 
 
+def _out_struct(shape, dtype, *arrs):
+    """``ShapeDtypeStruct`` whose varying-manual-axes (vma) is the union
+    of the inputs' — required for pallas_call outputs under a
+    ``check_vma=True`` shard_map (jax >= 0.7 tracks vma through avals);
+    a plain struct elsewhere."""
+    vma = set()
+    for a in arrs:
+        v = getattr(jax.typeof(a), "vma", None)
+        if v:
+            vma |= set(v)
+    if vma:
+        try:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+        except TypeError:  # pragma: no cover - older jax without vma kw
+            pass
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _hist_kernel_body(nbins: int, chunk: int, precision: str,
                       b_ref, g_ref, h_ref, out_ref):
     from jax.experimental import pallas as pl
@@ -68,7 +86,7 @@ def _histogram_tpu_impl(bins, grad, hess, nbins, precision, interpret):
         grid=(n // _CHUNK,),
         in_specs=[pl.BlockSpec((_CHUNK,), lambda i: (i,))] * 3,
         out_specs=pl.BlockSpec((nbins, 2), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((nbins, 2), jnp.float32),
+        out_shape=_out_struct((nbins, 2), jnp.float32, bins, grad, hess),
         interpret=interpret,
     )(bins, grad, hess)
 
@@ -181,9 +199,9 @@ def flash_block(q, k, v, m, l, o, mask, sm_scale):
         in_specs=in_specs,
         out_specs=[pl.BlockSpec((1, t), head2), pl.BlockSpec((1, t), head2),
                    pl.BlockSpec((1, t, d), head)],
-        out_shape=[jax.ShapeDtypeStruct((h, t), jnp.float32),
-                   jax.ShapeDtypeStruct((h, t), jnp.float32),
-                   jax.ShapeDtypeStruct((h, t, d), jnp.float32)],
+        out_shape=[_out_struct((h, t), jnp.float32, *ins),
+                   _out_struct((h, t), jnp.float32, *ins),
+                   _out_struct((h, t, d), jnp.float32, *ins)],
         interpret=_interpret(),
     )
 

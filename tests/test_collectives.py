@@ -14,7 +14,8 @@ from rabit_tpu.parallel import (
     make_mesh, device_allreduce, device_broadcast,
     ring_reduce_scatter, ring_all_gather, ring_allreduce, tree_allreduce,
 )
-from rabit_tpu.parallel.collectives import shard_over, shard_map
+from rabit_tpu.parallel.collectives import (
+    shard_over, shard_map, unchecked_shard_map)
 from jax.sharding import PartitionSpec as P
 
 NDEV = len(jax.devices())
@@ -80,7 +81,9 @@ def test_ring_reduce_scatter_ownership():
 def test_ring_all_gather_order():
     mesh = make_mesh(8)
     xs = np.arange(8 * 4, dtype=np.int32).reshape(8, 4)
-    f = shard_map(
+    # the ppermute-chain output is replicated by protocol, which the
+    # static checker cannot infer -> unchecked
+    f = unchecked_shard_map(
         lambda x: ring_all_gather(x.reshape(-1), "workers"),
         mesh=mesh, in_specs=P("workers"), out_specs=P())
     out = np.asarray(f(shard_over(mesh, xs)))

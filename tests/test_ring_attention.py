@@ -13,7 +13,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from rabit_tpu.parallel import (
     make_mesh, ring_attention, sequence_parallel_attention,
     reference_attention)
-from rabit_tpu.parallel.collectives import shard_map
+from rabit_tpu.parallel.collectives import shard_map, unchecked_shard_map
 
 P_DEV = 8
 T, H, D = 64, 8, 16   # global seq len, heads, head dim
@@ -110,7 +110,9 @@ def test_pallas_flash_block_parity(mesh, monkeypatch, causal):
     q, k, v = _qkv(seed=5)
     sharding = NamedSharding(mesh, P("sp"))
 
-    f = shard_map(
+    # pallas interpret mode's internal dynamic_slice trips the vma
+    # checker; the ring body is unchecked-scope anyway (ppermute chain)
+    f = unchecked_shard_map(
         functools.partial(ring_attention, axis_name="sp", causal=causal,
                           use_pallas=True),
         mesh=mesh, in_specs=(P("sp"),) * 3, out_specs=P("sp"))
