@@ -420,6 +420,103 @@ NetResult Comm::TryBroadcast(char* buf, size_t size, int root) {
   return NetResult::kOk;
 }
 
+// Targeted multicast along the deterministic complete binary tree
+// (tracker topology: parent=(r-1)/2). Every rank derives the same plan
+// from (src_rank, need): re-root the tree at src, keep only edges on a
+// src->requester path, stream with the same chunked forwarding as
+// TryBroadcast. O(world) plan time (process count, not data); traffic
+// O(size x subtree edges).
+NetResult Comm::TryRouteData(char* buf, size_t size, int src_rank,
+                             const std::vector<uint8_t>& need) {
+  if (world_ == 1 || size == 0) return NetResult::kOk;
+  const int P = world_;
+  bool any = false;
+  for (int r = 0; r < P; ++r) any = any || (need[r] != 0);
+  if (!any) return NetResult::kOk;
+  // BFS from src over tree edges: toward[r] = r's neighbor on the path
+  // to src; order[] has parents (src side) before children
+  std::vector<int> toward(P, -1), order;
+  std::vector<uint8_t> seen(P, 0), sub(P, 0);
+  order.reserve(P);
+  order.push_back(src_rank);
+  seen[src_rank] = 1;
+  for (size_t i = 0; i < order.size(); ++i) {
+    int u = order[i];
+    int nb[3] = {u > 0 ? (u - 1) / 2 : -1, 2 * u + 1, 2 * u + 2};
+    for (int v : nb) {
+      if (v < 0 || v >= P || seen[v]) continue;
+      seen[v] = 1;
+      toward[v] = u;
+      order.push_back(v);
+    }
+  }
+  // sub[r]: r's src-rooted subtree contains a requester (incl. r itself)
+  for (size_t i = order.size(); i-- > 0;) {
+    int u = order[i];
+    if (need[u]) sub[u] = 1;
+    if (sub[u] && toward[u] >= 0) sub[toward[u]] = 1;
+  }
+  const bool is_src = (rank_ == src_rank);
+  if (!is_src && !sub[rank_]) return NetResult::kOk;  // off every path
+
+  auto link_of = [&](int peer) {
+    for (size_t i = 0; i < links_.size(); ++i)
+      if (links_[i].peer_rank == peer) return static_cast<int>(i);
+    Fail(StrFormat("route peer %d not among links", peer));
+    return -1;
+  };
+  int in_link = is_src ? -1 : link_of(toward[rank_]);
+  std::vector<int> out_links;
+  int my_nb[3] = {rank_ > 0 ? (rank_ - 1) / 2 : -1, 2 * rank_ + 1,
+                  2 * rank_ + 2};
+  for (int v : my_nb) {
+    if (v < 0 || v >= P || toward[v] != rank_) continue;
+    if (sub[v]) out_links.push_back(link_of(v));
+  }
+
+  // stream: recv from in_link (src: already has data), forward chunks to
+  // out_links as they arrive — TryBroadcast's loop on the plan's links
+  std::vector<char> scratch;
+  char* data = buf;
+  if (!is_src && !need[rank_]) {
+    scratch.resize(size);
+    data = scratch.data();
+  }
+  size_t recvd = is_src ? size : 0;
+  std::vector<size_t> sent(out_links.size(), 0);
+  auto done = [&]() {
+    if (recvd < size) return false;
+    for (size_t i = 0; i < out_links.size(); ++i)
+      if (sent[i] < size) return false;
+    return true;
+  };
+  while (!done()) {
+    Poller poll;
+    if (in_link >= 0 && recvd < size)
+      poll.WatchRead(links_[in_link].conn.fd());
+    for (size_t i = 0; i < out_links.size(); ++i)
+      if (sent[i] < recvd) poll.WatchWrite(links_[out_links[i]].conn.fd());
+    if (poll.Wait(-1) < 0) return NetResult::kError;
+    NetResult res;
+    if (in_link >= 0 && recvd < size &&
+        poll.CanRead(links_[in_link].conn.fd())) {
+      ssize_t k = links_[in_link].conn.TryRecv(data + recvd, size - recvd,
+                                               &res);
+      if (k < 0) return res;
+      recvd += static_cast<size_t>(k);
+    }
+    for (size_t i = 0; i < out_links.size(); ++i) {
+      auto& conn = links_[out_links[i]].conn;
+      if (sent[i] < recvd && poll.CanWrite(conn.fd())) {
+        ssize_t k = conn.TrySend(data + sent[i], recvd - sent[i], &res);
+        if (k < 0) return res;
+        sent[i] += static_cast<size_t>(k);
+      }
+    }
+  }
+  return NetResult::kOk;
+}
+
 std::vector<size_t> Comm::RingRanges(size_t count, size_t elem_size) const {
   std::vector<size_t> off(world_ + 1, 0);
   size_t base = count / world_, rem = count % world_;

@@ -135,6 +135,17 @@ class Comm {
                                  ReduceFn reducer);
   NetResult TryAllgatherRing(char* buf, size_t elem_size, size_t count);
   NetResult TryBroadcast(char* buf, size_t size, int root);
+  // Targeted single-source multicast for recovery routing: stream
+  // ``size`` bytes from ``src_rank`` to exactly the ranks with
+  // ``need[r] != 0``, along complete-binary-tree paths (the tracker's
+  // topology is parent=(r-1)/2, so every rank derives the full tree
+  // locally). Ranks on no src->requester path return immediately —
+  // recovery traffic is O(data x routing-subtree), not O(data x world)
+  // (the capability of the reference's MsgPassing/TryRecoverData
+  // routing, allreduce_robust-inl.h:33-166, allreduce_robust.cc:749-861,
+  // built on plan-from-consensus instead of hop-by-hop passes).
+  NetResult TryRouteData(char* buf, size_t size, int src_rank,
+                         const std::vector<uint8_t>& need);
 
   // full-duplex fixed-size exchange with ring neighbors
   NetResult RingExchange(const char* send_buf, size_t send_n,

@@ -70,12 +70,19 @@ class RobustComm : public Comm {
 
   void CheckAndRecover(NetResult res);
 
-  // elect max (key, world-rank) across ranks; returns (key, rank)
-  std::pair<uint64_t, int> MaxKeyRank(uint64_t key);
-  // robust small allreduce used by consensus itself; retries through
-  // link resets
+  // robust small allreduce driving the ActionPod rounds ONLY; retries
+  // through link resets. Everything nested inside a round must be a
+  // non-retrying Try* call that unwinds errors back to RecoverExec, so
+  // after any failure every rank realigns at the same (idempotent)
+  // ActionPod allreduce — a retry nested inside serving would leave
+  // ranks in differently-shaped collectives on shared links.
   void ConsensusAllreduce(void* buf, size_t elem_size, size_t count,
                           ReduceFn fn);
+  // non-retrying elect of max (key, world-rank) across ranks
+  NetResult TryElect(uint64_t key, uint64_t* out_key, int* out_rank);
+  // one OR-reduced need-bitmask round; fills the agreed per-rank vector
+  NetResult AgreeNeed(bool mine, std::vector<uint8_t>* need,
+                      std::vector<uint8_t>* mask_scratch);
   NetResult TryServeLoadCheckpoint();
   NetResult TryServeReplay(uint32_t seq, void* buf, size_t size,
                            bool i_am_requester);

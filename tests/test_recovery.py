@@ -100,6 +100,42 @@ def test_force_local_reroute():
                        extra_args=["force_local=1", "mock=2,2,0,0"]) == 0
 
 
+# Reference CI scale: 10 workers, up to 20 restarts across the schedule
+# (dmlc-submit --num-workers=10 --local-num-attempt=20, test/test.mk:13-37).
+# Per-rank kill points have non-decreasing (version, trial) so every
+# entry actually fires: a respawned rank reloads at its kill version and
+# dies again when its trial coordinate matches its attempt count.
+STRESS_SCHEDULE = [
+    "mock=0,2,1,0", "mock=0,5,0,1",
+    "mock=1,1,1,0", "mock=1,1,1,1", "mock=1,1,1,2",   # triple die-hard
+    "mock=2,2,0,0", "mock=2,4,1,1",
+    "mock=3,2,2,0", "mock=3,2,2,1",
+    "mock=4,3,1,0", "mock=4,5,0,1",
+    "mock=5,3,0,0", "mock=5,5,2,1",
+    "mock=6,4,0,0", "mock=6,5,2,1",
+    "mock=7,4,0,0", "mock=7,6,0,1",                    # simultaneous w/ 6
+    "mock=8,5,1,0",
+    "mock=9,1,0,0", "mock=9,4,2,1",
+]
+
+
+def test_reference_scale_stress():
+    # 20 scripted deaths over 7 checkpoint versions at world=10; every
+    # collective self-verified analytically each iteration
+    assert run_cluster(10, "recover_worker.py",
+                       extra_args=STRESS_SCHEDULE,
+                       env={"N_ITER": "7"}, timeout=600) == 0
+
+
+def test_reference_scale_stress_with_local():
+    # the same schedule with ring-replicated local checkpoints healing
+    # through the batched plan + targeted routing
+    assert run_cluster(10, "recover_worker.py",
+                       extra_args=STRESS_SCHEDULE,
+                       env={"N_ITER": "7", "WITH_LOCAL": "1"},
+                       timeout=600) == 0
+
+
 def test_report_stats_smoke():
     # mock report_stats: per-version checkpoint sizes + collective time
     # printed through the tracker (reference allreduce_mock.h:95-103)

@@ -109,6 +109,17 @@ def test_result_log_thinning_recovery():
                                "mock=1,2,1,0"]) == 0
 
 
+def test_reference_scale_stress():
+    # 10 workers, 20 scripted restarts (reference test/test.mk:13-37
+    # scale) with every coded-op payload on the device mesh; each death
+    # advances the world epoch and re-forms the fixed-membership JAX
+    # world
+    from tests.test_recovery import STRESS_SCHEDULE
+    assert run_xla(10, "recover_worker.py",
+                   extra_args=STRESS_SCHEDULE,
+                   env={"N_ITER": "7"}, timeout=900) == 0
+
+
 def test_prepare_skipped_on_replay():
     """XlaEngine.allreduce skips prepare_fun on replay: the respawned
     rank's eagerly-cached op comes from the survivors' result logs, not
