@@ -26,6 +26,20 @@ void Comm::SetupFromConfig(const Config& cfg) {
       cfg.GetInt("rabit_reduce_ring_mincount", 32 << 10));
   reduce_buffer_ = cfg.GetSize("rabit_reduce_buffer", 256u << 20);
   debug_ = cfg.GetBool("rabit_debug", false);
+  // an accelerator data plane will be registered after Init (the Python
+  // binding calls RbtSetDataPlane post-RbtInit); advertising the intent
+  // at registration lets the tracker host a device-world coordinator on
+  // demand, whichever way the data plane was requested (argv, env, or
+  // the Python engine API)
+  std::string dp = cfg.Get("rabit_dataplane", "");
+  dataplane_intent_ = !dp.empty() && dp != "none";
+  // Hadoop-streaming heartbeat (reference ReportStatus,
+  // allreduce_base.h:215-220): emit reporter:status lines on stderr so
+  // a streaming scheduler does not kill long recoveries as hung tasks;
+  // on by default under Hadoop (mapred env present), opt-in elsewhere
+  report_status_ = cfg.GetBool(
+      "rabit_report_status", getenv("mapred_tip_id") != nullptr ||
+                                 getenv("mapreduce_task_id") != nullptr);
   StopProcessOnError() =
       cfg.GetBool("rabit_stop_process_on_error", false) ||
       // DMLC_WORKER_STOP_PROCESS_ON_ERROR normalizes to this key
@@ -94,11 +108,20 @@ void Comm::ReconnectLinks(const char* cmd) {
   t.SendStr(host_);
   t.SendU32(static_cast<uint32_t>(listener_.port()));
 
+  // registration flags: bit 0 advertises data-plane need, so the
+  // tracker hosts a device-world coordinator even when the data plane
+  // was requested through the Python engine API (invisible to the
+  // launcher's argv/env autodetect)
+  uint32_t flags = 0;
+  if (dataplane_intent_ || dataplane_ != nullptr) flags |= 1u;
+  t.SendU32(flags);
+
   // Assignment (tracker barriers until all world_size workers register,
   // so every peer below is already listening). epoch + coordinator: the
   // tracker hosts one device-world coordination service per registration
   // epoch — it must outlive any worker, because a vanished service
   // fatally poisons surviving clients (see engine/dataplane.py).
+  uint32_t prev_epoch = world_epoch_;
   rank_ = static_cast<int>(t.RecvU32());
   world_ = static_cast<int>(t.RecvU32());
   world_epoch_ = t.RecvU32();
@@ -133,6 +156,14 @@ void Comm::ReconnectLinks(const char* cmd) {
     int peer = static_cast<int>(c.RecvU32());
     c.SendU32(static_cast<uint32_t>(rank_));
     conns.emplace(peer, std::move(c));
+  }
+  // Epoch advanced while a device world may be formed: tell the data
+  // plane to drop its old client NOW, before the ready ack. Ordering
+  // contract with the tracker: once every member of the new epoch has
+  // acked, no client of any older epoch exists, so the tracker can reap
+  // old coordination services without poisoning a live client.
+  if (dataplane_ != nullptr && prev_epoch != 0 && world_epoch_ != prev_epoch) {
+    dataplane_(nullptr, 0, -1, -1, world_epoch_, dataplane_ctx_);
   }
   // ready ack: tracker knows this worker finished wiring
   t.SendU32(1u);
@@ -171,6 +202,17 @@ void Comm::ReconnectLinks(const char* cmd) {
                       world_, links_.size(),
                       parent_pos_ < 0 ? "none" : "yes"));
   }
+}
+
+// Hadoop-streaming heartbeat (reference ReportStatus,
+// allreduce_base.h:215-220, emitted each recovery round at
+// allreduce_robust.cc:1062): the reporter:status: prefix on stderr is
+// the streaming protocol's "task is alive" signal.
+void Comm::ReportStatus(const char* phase, uint32_t seq) const {
+  if (!report_status_) return;
+  fprintf(stderr, "reporter:status:Rabit Phase[%d] %s seq %u\n", version_,
+          phase, seq);
+  fflush(stderr);
 }
 
 // ---------------------------------------------------------------------------

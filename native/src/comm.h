@@ -164,6 +164,14 @@ class Comm {
   size_t ring_mincount_ = 32 << 10;   // reference default 32K elements
   size_t reduce_buffer_ = 256u << 20; // reference default 256MB
   bool debug_ = false;
+  // advertise at tracker registration that a data plane will be
+  // registered post-Init (rabit_dataplane config), so the tracker hosts
+  // a device-world coordinator on demand
+  bool dataplane_intent_ = false;
+  // Hadoop-streaming reporter:status heartbeat (reference ReportStatus,
+  // allreduce_base.h:215-220), emitted each recovery round
+  bool report_status_ = false;
+  void ReportStatus(const char* phase, uint32_t seq = 0) const;
 
   // accelerator data plane (see SetDataPlane)
   DataPlaneFn dataplane_ = nullptr;

@@ -127,6 +127,10 @@ void RobustComm::CheckAndRecover(NetResult res) {
 bool RobustComm::RecoverExec(void* buf, size_t size, uint32_t flag,
                              uint32_t my_seq, const std::string& cache_key) {
   for (;;) {
+    // heartbeat per consensus round (reference calls ReportStatus each
+    // RecoverExec round, allreduce_robust.cc:1062) so a streaming
+    // scheduler sees long recoveries as alive, not hung
+    ReportStatus("recover", my_seq);
     ActionPod act;
     act.flags = flag;
     act.seqno = my_seq;
@@ -443,7 +447,14 @@ void RobustComm::Allreduce(void* buf, size_t elem_size, size_t count,
   if (prepare) prepare(prepare_arg);
   double t0 = debug_ ? GetTime() : 0.0;
   std::string pristine(static_cast<char*>(buf), size);
-  for (;;) {
+  for (int attempt = 0;; ++attempt) {
+    // bounded, not infinite: a persistent misconfiguration (e.g. a data
+    // plane that can never form its device world) must fail loudly
+    // instead of spinning through reconnect cycles forever
+    RT_CHECK(attempt < 1000,
+             "allreduce failed after 1000 recovery attempts — persistent "
+             "failure, not a transient death (check data-plane/coordinator "
+             "configuration)");
     // execute step: accelerator data plane when eligible, socket
     // tree/ring otherwise — the robust wrapper structure of the
     // reference (allreduce_robust.cc:159-219 around TryAllreduce)
@@ -498,7 +509,10 @@ void RobustComm::Broadcast(void* buf, size_t size, int root,
   }
   double t0 = debug_ ? GetTime() : 0.0;
   std::string pristine(static_cast<char*>(buf), size);
-  for (;;) {
+  for (int attempt = 0;; ++attempt) {
+    RT_CHECK(attempt < 1000,
+             "broadcast failed after 1000 recovery attempts — persistent "
+             "failure, not a transient death");
     NetResult res = TryBroadcast(static_cast<char*>(buf), size, root);
     if (res == NetResult::kOk) {
       if (debug_) {

@@ -137,11 +137,18 @@ class NativeEngine(Engine):
         if self._variant != "auto" and \
                 not any(a.startswith("rabit_engine=") for a in argv):
             argv.append(f"rabit_engine={self._variant}")
-        arr = (ctypes.c_char_p * len(argv))(*[a.encode() for a in argv])
-        self._check(self._lib.RbtInit(len(argv), arr), "init")
         from ..utils.config import Config
         cfg = Config.from_args(args)
         kind = self._dataplane_kind or cfg.get("rabit_dataplane")
+        if kind == "xla" and \
+                not any(a.startswith("rabit_dataplane=") for a in argv):
+            # the engine-API path (NativeEngine(dataplane="xla")) must be
+            # visible to the C++ side BEFORE Init: registration
+            # advertises data-plane need so the tracker hosts a
+            # device-world coordinator on demand
+            argv.append("rabit_dataplane=xla")
+        arr = (ctypes.c_char_p * len(argv))(*[a.encode() for a in argv])
+        self._check(self._lib.RbtInit(len(argv), arr), "init")
         if kind == "xla" and self.is_distributed:
             from .dataplane import XlaDataPlane
             self._dataplane = XlaDataPlane(
@@ -153,6 +160,20 @@ class NativeEngine(Engine):
                 "set_dataplane")
         elif kind not in (None, "", "xla", "none"):
             raise ValueError(f"unknown rabit_dataplane {kind!r}")
+
+    @property
+    def world_epoch(self) -> int:
+        """The tracker's link-registration epoch — advances exactly when
+        the worker set was rewired (a recovery happened)."""
+        return int(self._lib.RbtWorldEpoch())
+
+    def set_world_reformed_callback(self, fn) -> None:
+        """``fn(epoch)`` fires after each device-world re-formation; use
+        it to re-``device_put`` application device state, which the
+        re-formation invalidates (see dataplane.py state contract)."""
+        if self._dataplane is None:
+            raise RuntimeError("no data plane registered")
+        self._dataplane.on_world_reformed = fn
 
     def shutdown(self) -> None:
         if self._dataplane is not None:

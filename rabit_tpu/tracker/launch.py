@@ -23,13 +23,17 @@ from .tracker import Tracker
 
 def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
            timeout: float = 300.0, quiet: bool = False,
-           coordinator: Optional[bool] = None) -> int:
+           coordinator: Optional[bool] = None,
+           stats: Optional[Dict] = None) -> int:
     """Run ``cmd`` as ``nworkers`` local processes under a tracker.
     Returns 0 on success. Workers exiting nonzero are respawned with an
     incremented attempt counter until ``max_attempts``. ``coordinator``
     makes the tracker host a per-epoch device-world coordination service
     (required by the XLA data plane); default: auto-detect from the
-    worker command / environment."""
+    worker command / environment. Workers additionally advertise
+    data-plane need in their tracker-registration flags, so the
+    coordinator is hosted on demand even when the data plane was
+    selected through the Python engine API (invisible here)."""
     if coordinator is None:
         coordinator = (os.environ.get("RABIT_DATAPLANE") == "xla"
                        or any(a == "rabit_dataplane=xla" for a in cmd))
@@ -79,6 +83,11 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
         raise RuntimeError(
             f"timeout/stall: finished={sum(finished.values())}/{nworkers}")
     finally:
+        if stats is not None:
+            # observability for tests: retained coordination services
+            # must stay bounded no matter how many recovery epochs ran
+            stats["services_retained"] = tracker.service_count()
+            stats["total_attempts"] = sum(attempts.values())
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
