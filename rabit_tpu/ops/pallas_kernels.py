@@ -1,24 +1,30 @@
 """Pallas TPU kernels for the hot ops.
 
-``histogram_kernel``: XGBoost-style gradient-histogram accumulation —
-the per-row scatter-add the reference's use case feeds into its
-allreduce (doc/guide.md:137-143). TPUs have no hardware scatter, so the
-kernel reformulates the scatter as a one-hot × gradient matmul on the
-MXU, accumulated into a VMEM-resident [nbins, 2] block across a
-sequential row-chunk grid:
+``histogram_tpu``: XGBoost-style gradient-histogram accumulation — the
+per-row scatter-add the reference's use case feeds into its allreduce
+(doc/guide.md:137-143). TPUs have no hardware scatter, so the kernel
+reformulates the scatter as masked matmuls on the MXU through a
+TWO-LEVEL bin decomposition, bin = hi*128 + lo:
 
-- one-hot mask built on the VPU via broadcasted-iota compare (exact in
-  bfloat16: values are 0/1);
-- default ``precision="high"``: gradients split hi/lo into two bfloat16
-  components so two dots recover ~float32 accuracy (max rel err ~2e-6);
-- ``precision="fast"``: a single bf16 MXU dot with f32 accumulation —
-  per-bin relative error ~2e-4 on 2M rows (random signs average out),
-  inside split-finding tolerance; ~1.3x faster, explicit opt-in;
-- chunk size 8192 measured best on the current chip (Mosaic tiles the
-  [chunk, nbins] mask internally).
+- the [chunk, 128] low-level one-hot (``lo == c``, full lane width —
+  one compare per row x lane, built once and shared by every gradient
+  component) selects each component into the rhs; the [chunk, A]
+  high-level one-hot is the dot's lhs, so
+  out_k[a, c] = sum_rows [hi==a]*[lo==c]*gh_k needs
+  O(chunk * (A + 128)) compares instead of the naive one-hot's
+  O(chunk * nbins), and the dot's N dimension is exactly one lane tile;
+- default ``precision="high"``: gradients ride as four f32 components
+  (bf16 hi/lo splits of grad and hess) recombined after the kernel —
+  ~2e-6 relative accuracy at ~20% over the fast path's cost;
+- ``precision="fast"``: two components (grad, hess) cast to bf16 —
+  per-bin relative error ~2e-4 on 2M rows, inside split-finding
+  tolerance;
+- VMEM per grid step is O(chunk * 128) regardless of nbins (the naive
+  [chunk, nbins] mask OOM'd v5e's 16 MB scoped vmem at 1024 bins).
 
-Measured (tunnelled TPU, 2M rows, 1024 bins, amortized over 32 calls):
-fast ~5.9 ms, high ~16 ms, XLA ``segment_sum`` ~229 ms.
+Measured on v5e (2M rows, 1024 bins, dispatch-floor-cancelled slope
+timing — see bench.py): high ~4.3 ms, fast ~3.1 ms, XLA ``segment_sum``
+~15 ms; the naive full-width one-hot kernel ran ~7 ms fast / OOM high.
 """
 
 from __future__ import annotations
@@ -27,9 +33,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
-_CHUNK = 8192
+_CHUNK = 16384   # rows per grid step
+_ATILE = 512    # high-level bin groups per grid step (VMEM bound)
 
 
 def _out_struct(shape, dtype, *arrs):
@@ -50,29 +58,40 @@ def _out_struct(shape, dtype, *arrs):
     return jax.ShapeDtypeStruct(shape, dtype)
 
 
-def _hist_kernel_body(nbins: int, chunk: int, precision: str,
-                      b_ref, g_ref, h_ref, out_ref):
+def _hist_kernel_body(r: int, cbits: int, atile: int, chunk: int, *refs):
     from jax.experimental import pallas as pl
 
-    step = pl.program_id(0)
+    b_ref, comp_refs, out_ref = refs[0], refs[1:1 + r], refs[1 + r]
+    j = pl.program_id(0)   # a-tile (outer)
+    i = pl.program_id(1)   # row chunk (inner: out block stays resident)
 
-    @pl.when(step == 0)
+    @pl.when(i == 0)
     def _init():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    bb = b_ref[:]
-    iota = jax.lax.broadcasted_iota(jnp.int32, (chunk, nbins), 1)
-    onehot = (bb[:, None] == iota).astype(jnp.bfloat16)  # exact 0/1
-    gh = jnp.stack([g_ref[:], h_ref[:]], axis=1)         # [chunk, 2] f32
-    dot = lambda x, y: jax.lax.dot_general(  # noqa: E731
-        x, y, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    if precision == "high":
-        hi = gh.astype(jnp.bfloat16)
-        lo = (gh - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-        out_ref[:] += dot(onehot, hi) + dot(onehot, lo)
-    else:
-        out_ref[:] += dot(onehot, gh.astype(jnp.bfloat16))
+    cdim = 1 << cbits                                # 128: one lane tile
+    bb = b_ref[:]                                    # [chunk] int32
+    hi_id = jax.lax.shift_right_logical(bb, cbits)   # bin = hi*C + lo
+    lo_id = jax.lax.bitwise_and(bb, cdim - 1)
+    iota_c = jax.lax.broadcasted_iota(jnp.int32, (chunk, cdim), 1)
+    # ONE full-lane-width low mask shared by every gh component
+    lo_match = lo_id[:, None] == iota_c              # [chunk, 128] bool
+    a0 = j * atile
+    iota_a = jax.lax.broadcasted_iota(jnp.int32, (chunk, atile), 1) + a0
+    h_mask = (hi_id[:, None] == iota_a).astype(jnp.bfloat16)
+    # hist factorizes through the two-level decomposition:
+    # out_k[a, c] = sum_rows [hi==a] * [lo==c] * gh_k
+    # -> per component ONE [atile, chunk] x [chunk, 128] MXU dot, with
+    # compares O(chunk*(A+C)) instead of O(chunk*nbins); the rhs is a
+    # single select per component against the shared full-width mask
+    # (comp broadcast is f32 [chunk, 1] — Mosaic minor-dim insertion is
+    # 32-bit only)
+    for k in range(r):
+        col = comp_refs[k][:][:, None]               # f32 [chunk, 1]
+        rhs = jnp.where(lo_match, col, 0.0).astype(jnp.bfloat16)
+        out_ref[k] += jax.lax.dot_general(
+            h_mask, rhs, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
 
 @functools.partial(jax.jit,
@@ -81,22 +100,47 @@ def _histogram_tpu_impl(bins, grad, hess, nbins, precision, interpret):
     from jax.experimental import pallas as pl
 
     n = bins.shape[0]
-    return pl.pallas_call(
-        functools.partial(_hist_kernel_body, nbins, _CHUNK, precision),
-        grid=(n // _CHUNK,),
-        in_specs=[pl.BlockSpec((_CHUNK,), lambda i: (i,))] * 3,
-        out_specs=pl.BlockSpec((nbins, 2), lambda i: (0, 0)),
-        out_shape=_out_struct((nbins, 2), jnp.float32, bins, grad, hess),
+    if precision == "high":
+        # the barrier is load-bearing: under --xla_allow_excess_precision
+        # XLA folds the bf16 round-trip to identity, turning lo into
+        # exact zeros and silently degrading "high" to "fast".
+        # components stay f32 on the wire (1D, no lane padding); the
+        # values are bf16-representable so the in-kernel cast is exact
+        g_hi = jax.lax.optimization_barrier(
+            grad.astype(jnp.bfloat16)).astype(jnp.float32)
+        h_hi = jax.lax.optimization_barrier(
+            hess.astype(jnp.bfloat16)).astype(jnp.float32)
+        comps = (g_hi, h_hi, grad - g_hi, hess - h_hi)
+    else:
+        comps = (grad, hess)
+    r = len(comps)                                       # 2 or 4
+    cdim, cbits = 128, 7                                 # one lane tile
+    adim = -(-nbins // cdim)                             # ceil
+    atile = min(_ATILE, adim)
+    nat = -(-adim // atile)
+    a_pad = nat * atile
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_body, r, cbits, atile, _CHUNK),
+        grid=(nat, n // _CHUNK),
+        in_specs=[pl.BlockSpec((_CHUNK,), lambda j, i: (i,))] * (1 + r),
+        out_specs=pl.BlockSpec((r, atile, cdim), lambda j, i: (0, j, 0)),
+        out_shape=_out_struct((r, a_pad, cdim), jnp.float32,
+                              bins, grad, hess),
         interpret=interpret,
-    )(bins, grad, hess)
+    )(bins, *comps)
+    # out[k, a, c] -> [r, a_pad*C] -> slice bins -> [nbins, 2]
+    comps = out.reshape(r, -1)[:, :nbins]
+    if precision == "high":
+        comps = comps[:2] + comps[2:]                    # hi + lo
+    return comps.T                                       # [nbins, 2]
 
 
 def histogram_tpu(bins: jax.Array, grad: jax.Array, hess: jax.Array,
                   nbins: int, precision: str = "high") -> jax.Array:
     """Per-bin (sum_g, sum_h): [nbins, 2]. Rows whose bin id is >= nbins
-    (used for padding) contribute nothing. Requires len % 8192 == 0;
+    (used for padding) contribute nothing. Requires len % _CHUNK == 0;
     callers pad with bin id == nbins. ``precision``: "high" (default,
-    hi/lo split, ~2e-6 rel err) or "fast" (single bf16 dot, ~2e-4).
+    hi/lo split, ~2e-6 rel err) or "fast" (bf16 components, ~2e-4).
 
     The interpret flag is part of the jit key here, so flipping
     ``RABIT_PALLAS_INTERPRET`` between calls retraces correctly; a jit'd
@@ -150,6 +194,9 @@ def flash_block_available() -> bool:
 
 
 def _flash_block_body(has_mask, sm_scale, *refs):
+    # m/l ride as [1, T, 1] blocks: compiled Mosaic requires the last
+    # two block dims to be (divisible by 8, divisible by 128) or equal
+    # to the array dims — a [1, T] block of an [H, T] array is neither
     if has_mask:
         q_ref, k_ref, v_ref, m_ref, l_ref, o_ref, mask_ref, \
             mo_ref, lo_ref, oo_ref = refs
@@ -161,12 +208,12 @@ def _flash_block_body(has_mask, sm_scale, *refs):
     s = dot(q_ref[0], k_ref[0], ((1,), (1,))) * sm_scale     # [T, S] f32
     if has_mask:
         s = jnp.where(mask_ref[:] != 0, NEG_INF, s)
-    m_old = m_ref[0]                                          # [T]
+    m_old = m_ref[0][:, 0]                                    # [T]
     m_new = jnp.maximum(m_old, s.max(axis=-1))
     alpha = jnp.exp(m_old - m_new)
     p = jnp.exp(s - m_new[:, None])
-    mo_ref[0] = m_new
-    lo_ref[0] = l_ref[0] * alpha + p.sum(axis=-1)
+    mo_ref[0] = m_new[:, None]
+    lo_ref[0] = (l_ref[0][:, 0] * alpha + p.sum(axis=-1))[:, None]
     oo_ref[0] = o_ref[0] * alpha[:, None] + \
         dot(p.astype(v_ref.dtype), v_ref[0], ((1,), (0,)))
 
@@ -174,46 +221,82 @@ def _flash_block_body(has_mask, sm_scale, *refs):
 def flash_block(q, k, v, m, l, o, mask, sm_scale):
     """Pallas twin of ring_attention's ``_block_update``: same contract
     (q [H,T,D]; k/v [H,S,D]; m/l [H,T] f32; o [H,T,D] f32; mask [T,S]
-    bool or None) and same return (m', l', o'). Forward-only — the
-    training path uses the differentiable jnp formulation."""
+    bool or None) and same return (m', l', o').
+
+    Differentiable via a recompute-based custom VJP: the forward runs
+    the MXU kernel; the backward re-derives the block update with the
+    mathematically identical jnp formulation (``_block_update``,
+    parity-tested against this kernel) and differentiates that — the
+    standard flash-attention trade of recompute for memory, with XLA
+    generating the backward. Inputs are cheap to save (the live K/V
+    block is already resident in the ring scan carry)."""
     from jax.experimental import pallas as pl
 
     h, t, d = q.shape
     s_len = k.shape[1]
     has_mask = mask is not None
     head = lambda i: (i, 0, 0)       # noqa: E731
-    head2 = lambda i: (i, 0)         # noqa: E731
     whole = lambda i: (0, 0)         # noqa: E731
     in_specs = [
         pl.BlockSpec((1, t, d), head), pl.BlockSpec((1, s_len, d), head),
-        pl.BlockSpec((1, s_len, d), head), pl.BlockSpec((1, t), head2),
-        pl.BlockSpec((1, t), head2), pl.BlockSpec((1, t, d), head),
+        pl.BlockSpec((1, s_len, d), head), pl.BlockSpec((1, t, 1), head),
+        pl.BlockSpec((1, t, 1), head), pl.BlockSpec((1, t, d), head),
     ]
     ins = [q, k, v, m, l, o]
     if has_mask:
         in_specs.append(pl.BlockSpec((t, s_len), whole))
         ins.append(mask.astype(jnp.int8))
-    call = pl.pallas_call(
+    raw_call = pl.pallas_call(
         functools.partial(_flash_block_body, has_mask, sm_scale),
         grid=(h,),
         in_specs=in_specs,
-        out_specs=[pl.BlockSpec((1, t), head2), pl.BlockSpec((1, t), head2),
+        out_specs=[pl.BlockSpec((1, t, 1), head),
+                   pl.BlockSpec((1, t, 1), head),
                    pl.BlockSpec((1, t, d), head)],
-        out_shape=[_out_struct((h, t), jnp.float32, *ins),
-                   _out_struct((h, t), jnp.float32, *ins),
+        out_shape=[_out_struct((h, t, 1), jnp.float32, *ins),
+                   _out_struct((h, t, 1), jnp.float32, *ins),
                    _out_struct((h, t, d), jnp.float32, *ins)],
         interpret=_interpret(),
     )
 
-    @jax.custom_jvp
-    def run(*arrs):
-        return call(*arrs)
+    def call(q, k, v, m, l, o, *rest):
+        # m/l ride as [H, T, 1] through the kernel (tiling note above)
+        mo, lo, oo = raw_call(q, k, v, m[..., None], l[..., None], o,
+                              *rest)
+        return mo[..., 0], lo[..., 0], oo
 
-    @run.defjvp
-    def _no_ad(primals, tangents):  # noqa: ANN001
-        raise NotImplementedError(
-            "flash_block is forward-only (no AD rule for the Pallas "
-            "kernel); use the default jnp path (use_pallas=False) when "
-            "differentiating")
+    def _jnp_twin(q, k, v, m, l, o, mask_i8):
+        from ..parallel.ring_attention import _block_update
+        return _block_update(q, k, v, m, l, o,
+                             None if mask_i8 is None else mask_i8 != 0,
+                             sm_scale)
 
+    if has_mask:
+        @jax.custom_vjp
+        def run(q, k, v, m, l, o, mask_i8):
+            return call(q, k, v, m, l, o, mask_i8)
+
+        def fwd(q, k, v, m, l, o, mask_i8):
+            return run(q, k, v, m, l, o, mask_i8), \
+                (q, k, v, m, l, o, mask_i8)
+
+        def bwd(res, ct):
+            *prim, mask_i8 = res
+            _, vjp = jax.vjp(
+                lambda *a: _jnp_twin(*a, mask_i8), *prim)
+            mask_ct = np.zeros(mask_i8.shape, jax.dtypes.float0)
+            return (*vjp(ct), mask_ct)
+    else:
+        @jax.custom_vjp
+        def run(q, k, v, m, l, o):
+            return call(q, k, v, m, l, o)
+
+        def fwd(*prim):
+            return run(*prim), prim
+
+        def bwd(res, ct):
+            _, vjp = jax.vjp(lambda *a: _jnp_twin(*a, None), *res)
+            return vjp(ct)
+
+    run.defvjp(fwd, bwd)
     return run(*ins)

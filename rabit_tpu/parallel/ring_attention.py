@@ -222,8 +222,9 @@ def sequence_parallel_attention(q, k, v, mesh: Mesh, causal: bool = False,
     sharded across ``axis`` (T divisible by the axis size). ``impl`` is
     ``"ring"`` (blockwise K/V rotation) or ``"ulysses"`` (all-to-all head
     scatter; needs H divisible by the axis size). ``use_pallas`` runs the
-    ring path's per-block step as the Pallas flash kernel — currently
-    forward-only; leave False when differentiating."""
+    ring path's per-block step as the Pallas flash kernel (differentiable:
+    the backward recomputes through the jnp twin — flash_block's custom
+    VJP)."""
     if impl not in ("ring", "ulysses"):
         raise ValueError(f"impl must be 'ring' or 'ulysses', got {impl!r}")
     if use_pallas and impl != "ring":

@@ -134,23 +134,33 @@ def test_pallas_via_public_wrapper(mesh, monkeypatch):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_pallas_forward_only_guard(mesh, monkeypatch):
-    """Differentiating the pallas path fails with a clear message, not an
-    opaque pallas_call AD error."""
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_grads_match_reference(mesh, monkeypatch, causal):
+    """Training through the Pallas flash path: gradients of the ring
+    attention with use_pallas=True (recompute-based custom VJP) match
+    the dense single-device oracle (VERDICT r2 #4 — previously
+    forward-only)."""
     monkeypatch.setenv("RABIT_PALLAS_INTERPRET", "1")
     q, k, v = _qkv(seed=12)
     sharding = NamedSharding(mesh, P("sp"))
     args = tuple(jax.device_put(x, sharding) for x in (q, k, v))
 
-    def loss(q, k, v):
-        f = shard_map(
+    def ref_loss(q, k, v):
+        return (reference_attention(q, k, v, causal=causal) ** 2).sum()
+
+    def sp_loss(q, k, v):
+        f = unchecked_shard_map(
             functools.partial(ring_attention, axis_name="sp",
-                              use_pallas=True),
+                              causal=causal, use_pallas=True),
             mesh=mesh, in_specs=(P("sp"),) * 3, out_specs=P("sp"))
         return (f(q, k, v) ** 2).sum()
 
-    with pytest.raises(NotImplementedError, match="forward-only"):
-        jax.grad(loss)(*args)
+    want = jax.grad(ref_loss, argnums=(0, 1, 2))(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
+    got = jax.grad(jax.jit(sp_loss), argnums=(0, 1, 2))(*args)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=5e-4, atol=5e-4)
 
 
 def test_bad_impl_rejected(mesh):
