@@ -8,14 +8,15 @@
 // semantics for cross-checking and speed comparison (test/Makefile:60-62
 // builds speed_test against both engines).
 //
-// NOTE: the build image for this repo has no MPI; this engine is
-// compile-gated and exercised only where an MPI toolchain exists.
+// Built when an MPI runtime is available: either a full toolchain
+// (-DRT_MPI_REAL_HEADER with <mpi.h>) or the header-less OpenMPI
+// runtime this image ships, declared through mpi_abi_shim.h.
 #ifndef RT_ENGINE_MPI_H_
 #define RT_ENGINE_MPI_H_
 
 #ifdef RT_WITH_MPI
 
-#include <mpi.h>
+#include "mpi_abi_shim.h"
 
 #include <cstdio>
 #include <string>
@@ -49,17 +50,32 @@ class MpiComm : public Comm {
     cfg_.LoadArgs(argc, argv);
     cfg_.LoadHadoopEnv();  // last: explicit env/argv settings win
     SetupFromConfig(cfg_);
-    int flag = 0;
-    MPI_Initialized(&flag);
-    if (!flag) MPI_Init(nullptr, nullptr);
+    int finalized = 0;
+    MPI_Finalized(&finalized);
+    if (finalized) {
+      // MPI cannot be re-initialized after MPI_Finalize; fail loudly
+      // instead of calling MPI_Comm_rank on finalized MPI (which
+      // aborts the process, bypassing the error-return ABI)
+      Fail("MPI was already finalized in this process; the MPI engine "
+           "cannot be re-initialized (MPI_Init-once semantics)");
+    }
+    int inited = 0;
+    MPI_Initialized(&inited);
+    if (!inited) {
+      MPI_Init(nullptr, nullptr);
+      we_initialized_ = true;
+    }
     MPI_Comm_rank(MPI_COMM_WORLD, &rank_);
     MPI_Comm_size(MPI_COMM_WORLD, &world_);
   }
 
   void Shutdown() override {
+    FreeCachedOp();
     int flag = 0;
     MPI_Finalized(&flag);
-    if (!flag) MPI_Finalize();
+    // only finalize an MPI this engine initialized: the host program
+    // (e.g. mpi4py) may own the MPI lifecycle
+    if (!flag && we_initialized_) MPI_Finalize();
   }
 
   bool is_distributed() const override { return world_ > 1; }
@@ -69,16 +85,24 @@ class MpiComm : public Comm {
                  const char* = "", int = -1, int = -1) override {
     if (prepare) prepare(prepare_arg);
     if (world_ == 1 || count == 0) return;
-    MPI_Datatype dtype;
-    MPI_Type_contiguous(static_cast<int>(elem_size), MPI_BYTE, &dtype);
-    MPI_Type_commit(&dtype);
-    MPI_Op op;
-    mpi_detail::Ctx().fn = reducer;
-    MPI_Op_create(mpi_detail::Trampoline, /*commute=*/1, &op);
-    MPI_Allreduce(MPI_IN_PLACE, buf, static_cast<int>(count), dtype, op,
-                  MPI_COMM_WORLD);
-    MPI_Op_free(&op);
-    MPI_Type_free(&dtype);
+    // cache the committed datatype (keyed by elem_size) and the op
+    // across calls — per-call create/commit/free would bias the speed
+    // comparison this engine exists for (the reference's ReduceHandle
+    // reuses both, engine_mpi.cc:189-237)
+    if (cached_elem_size_ != elem_size) {
+      if (cached_elem_size_ != 0) MPI_Type_free(&cached_dtype_);
+      MPI_Type_contiguous(static_cast<int>(elem_size), MPI_BYTE,
+                          &cached_dtype_);
+      MPI_Type_commit(&cached_dtype_);
+      cached_elem_size_ = elem_size;
+    }
+    if (!op_created_) {
+      MPI_Op_create(mpi_detail::Trampoline, /*commute=*/1, &cached_op_);
+      op_created_ = true;
+    }
+    mpi_detail::Ctx().fn = reducer;  // trampoline dispatches per call
+    MPI_Allreduce(MPI_IN_PLACE, buf, static_cast<int>(count),
+                  cached_dtype_, cached_op_, MPI_COMM_WORLD);
   }
 
   void Broadcast(void* buf, size_t size, int root, const char* = "")
@@ -96,6 +120,27 @@ class MpiComm : public Comm {
   // LoadCheckpoint/Checkpoint/LazyCheckpoint: inherited version-only
   // no-ops from Comm — matching the reference MPI engine's explicit
   // non-fault-tolerance (engine_mpi.cc:47-60).
+
+ private:
+  void FreeCachedOp() {
+    int finalized = 0;
+    MPI_Finalized(&finalized);
+    if (finalized) return;  // handles die with MPI
+    if (op_created_) {
+      MPI_Op_free(&cached_op_);
+      op_created_ = false;
+    }
+    if (cached_elem_size_ != 0) {
+      MPI_Type_free(&cached_dtype_);
+      cached_elem_size_ = 0;
+    }
+  }
+
+  bool we_initialized_ = false;
+  bool op_created_ = false;
+  size_t cached_elem_size_ = 0;
+  MPI_Datatype cached_dtype_{};
+  MPI_Op cached_op_{};
 };
 
 }  // namespace rt

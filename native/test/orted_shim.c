@@ -1,0 +1,5 @@
+/* orted reconstructed from libopen-rte (the Debian runtime package
+   ships the library but not the binary): the real orted's main() is a
+   one-line call to orte_daemon(). */
+extern int orte_daemon(int argc, char *argv[]);
+int main(int argc, char *argv[]) { return orte_daemon(argc, argv); }

@@ -79,6 +79,10 @@ def init(args: Optional[list] = None, engine: str = "auto", **kwargs) -> None:
             src/allreduce_robust.cc).
           - ``"mock"``: robust engine + scripted fault injection (reference
             src/allreduce_mock.h).
+          - ``"mpi"``: collectives on MPI_COMM_WORLD — the independent
+            second implementation, not fault tolerant (reference
+            src/engine_mpi.cc); needs an MPI runtime (see
+            native/src/mpi_abi_shim.h for the header-less-image path).
           - ``"xla"``: JAX/XLA collectives over the device mesh (TPU-native
             data plane; no reference equivalent — this is the point).
           - ``"robust_xla"``: the north-star composition — the C++
@@ -109,7 +113,7 @@ def init(args: Optional[list] = None, engine: str = "auto", **kwargs) -> None:
         elif engine == "xla":
             from .engine.xla import XlaEngine
             _engine = XlaEngine()
-        elif engine in ("native", "base", "robust", "mock"):
+        elif engine in ("native", "base", "robust", "mock", "mpi"):
             from .engine.native import NativeEngine
             _engine = NativeEngine(variant=engine)
         elif engine == "robust_xla":
