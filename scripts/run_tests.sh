@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# CI test runner — the role of the reference's scripts/travis_script.sh
+# + travis_runtest.sh: build everything, then run every test tier on
+# every push. Tiers mirror SURVEY §4:
+#   1. native unit/self tests (single process)
+#   2. multi-process integration with fault injection (tracker respawn)
+#   3. device-mesh + model tests on the virtual CPU mesh
+# Usage: scripts/run_tests.sh [quick]   ("quick" skips the slow
+# recovery/stress tiers; default runs everything)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== build native =="
+cmake -S native -B native/build -G Ninja >/dev/null
+cmake --build native/build --parallel
+
+echo "== tier 1: native unit tests =="
+./native/build/rt_selftest
+./native/build/api_test
+
+if [[ "${1:-}" == "quick" ]]; then
+  echo "== quick: package + collectives + models =="
+  python -m pytest tests/test_config.py tests/test_reducers.py \
+      tests/test_api_single.py tests/test_collectives.py -q -x
+  exit 0
+fi
+
+echo "== tier 2+3: full pytest suite =="
+python -m pytest tests/ -q -x
+
+echo "ALL TESTS PASSED"

@@ -136,6 +136,30 @@ def test_reference_scale_stress_with_local():
                        timeout=600) == 0
 
 
+def test_replica_loss_fails_loudly():
+    """When EVERY holder of a result dies before a requester replays it,
+    the data is genuinely unrecoverable. Pin the failure mode: the job
+    must fail fast and loudly (the reference also errors in TryGetResult
+    when no node can provide, allreduce_robust.cc:991-1028), never hang.
+
+    world=4 with rabit_global_replica=2 -> result_round=2: seq 1 is held
+    only by ranks 1 and 3. Both die at (v1, s2) — AFTER logging seq 1
+    (dying at s1 itself loses nothing: the collective never completed
+    anywhere and is simply re-executed) — so every copy of seq 1 is
+    gone when their respawns request its replay."""
+    # max_attempts=1: the scripted kill uses the one allowed respawn;
+    # the respawn then dies on the loud unrecoverable-replay check
+    # ("replay of op 1 requested but no rank has it") and the launcher
+    # gives up immediately instead of cycling doomed restarts
+    # match "failed" ONLY: the stall/timeout RuntimeError must NOT
+    # satisfy this test — a hang is the regression it exists to catch
+    with pytest.raises(RuntimeError, match="failed"):
+        run_cluster(4, "recover_worker.py",
+                    extra_args=["rabit_global_replica=2",
+                                "mock=1,1,2,0", "mock=3,1,2,0"],
+                    timeout=150, max_attempts=1)
+
+
 def test_report_stats_smoke():
     # mock report_stats: per-version checkpoint sizes + collective time
     # printed through the tracker (reference allreduce_mock.h:95-103)
