@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Train the flagship long-context transformer LM on REAL TPU hardware
+and record the evidence in-repo (FLAGSHIP_HW_<ts>.json): loss must
+decrease over compiled SPMD train steps on the chip, with step timing.
+Complements the CPU-mesh tests (which prove multi-axis sharding) and
+KERNEL_HW (which proves the Pallas kernels): this proves the full model
+training loop — embedding, ring-attention path, Megatron-style TP ops,
+optimizer — compiles and learns on the device.
+
+Usage: python tools/flagship_hw_proof.py   (needs the TPU tunnel up)
+"""
+
+import datetime
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh
+
+    backend = jax.default_backend()
+    if backend != "tpu":
+        raise SystemExit(f"needs a TPU backend, got {backend}")
+
+    from rabit_tpu.models import transformer as tf
+
+    devs = np.array(jax.devices()).reshape(-1, 1, 1)
+    mesh = Mesh(devs, ("dp", "tp", "sp"))
+    params = tf.init_params(jax.random.PRNGKey(0), vocab=256, n_layers=2,
+                            d_model=256, n_heads=8, d_head=32, d_ff=1024,
+                            max_t=512)
+    step = tf.make_train_step(mesh, lr=0.1)
+    rng = np.random.default_rng(0)
+    # learnable structure: next token = (token + 1) % vocab, random phase
+    seq = np.arange(768, dtype=np.int64) % 256
+    tokens = np.stack([np.roll(seq, -int(s))[:513] for s in
+                       rng.integers(0, 256, size=8)])
+    x = jnp.asarray(tokens[:, :512].astype(np.int32))
+    y = jnp.asarray(tokens[:, 1:513].astype(np.int32))
+
+    losses = []
+    t_first = t_steady = None
+    n_steps = 16
+    for i in range(n_steps):
+        t0 = time.perf_counter()
+        params, loss = step(params, x, y)
+        loss = float(np.asarray(loss))
+        dt = time.perf_counter() - t0
+        if i == 0:
+            t_first = dt
+        else:
+            t_steady = dt if t_steady is None else min(t_steady, dt)
+        losses.append(round(loss, 4))
+        print(f"step {i}: loss {loss:.4f} ({dt:.2f}s)", flush=True)
+
+    # average the last quarter of steps: the single final-step loss is
+    # the noisiest statistic (SGD oscillates near convergence)
+    tail = sum(losses[-4:]) / 4
+    assert tail < losses[0] - 0.8, \
+        f"loss did not decrease: {losses[0]} -> tail mean {tail:.4f}"
+
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ")
+    payload = {
+        "backend": backend,
+        "device": str(jax.devices()[0]),
+        "model": {"layers": 2, "d_model": 256, "heads": 8, "d_ff": 1024,
+                  "seq_len": 512, "batch": 8, "vocab": 256},
+        "losses": losses,
+        "compile_plus_first_step_s": round(t_first, 2),
+        "best_step_s": round(t_steady, 3),
+        "timestamp_utc": ts,
+    }
+    path = os.path.join(_REPO, f"FLAGSHIP_HW_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
