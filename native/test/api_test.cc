@@ -19,6 +19,7 @@
 #include <cstdlib>
 #include <cstdio>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 static int g_prepare_calls = 0;
@@ -156,6 +157,29 @@ int main(int argc, char* argv[]) {
   TestSingleNodeCollectives();
   TestCheckpointRoundtrip();
   TestCustomReducers();
+
+  // per-thread engine store (reference ThreadLocalStore/EngineThreadLocal,
+  // engine.cc:33-43): another thread owns an INDEPENDENT slot — it sees
+  // the pre-Init fallback (version 0), not this thread's engine, and can
+  // run its own isolated world-1 lifecycle without touching ours
+  Model marker;
+  const int base_version = rabit::VersionNumber();
+  rabit::CheckPoint(&marker);
+  CHECK(rabit::VersionNumber() == base_version + 1);
+  bool thread_ok = false;
+  std::thread([&thread_ok] {
+    bool ok = rabit::GetRank() == 0 && rabit::GetWorldSize() == 1 &&
+              rabit::VersionNumber() == 0;  // NOT the main thread's 1
+    rabit::Init(0, nullptr);
+    float v[2] = {2.0f, 3.0f};
+    rabit::Allreduce<rabit::op::Sum>(v, 2);  // world-1 no-op, must work
+    ok = ok && v[0] == 2.0f && rabit::VersionNumber() == 0;
+    rabit::Finalize();
+    thread_ok = ok;
+  }).join();
+  CHECK(thread_ok);
+  CHECK(rabit::VersionNumber() == base_version + 1);  // ours untouched
+  std::printf("thread-local engine store ok\n");
 
   rabit::Finalize();
   std::printf("api_test: all ok\n");
