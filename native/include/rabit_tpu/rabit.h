@@ -20,7 +20,9 @@
 #ifndef RABIT_TPU_RABIT_H_
 #define RABIT_TPU_RABIT_H_
 
+#include <cstdarg>
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <stdexcept>
 #include <string>
@@ -229,6 +231,22 @@ inline std::string GetProcessorName() {
 /// tracker console; reference rabit.h:119-130).
 inline void TrackerPrint(const std::string& msg) {
   detail::Check(RbtTrackerPrint(msg.c_str()), "TrackerPrint");
+}
+
+/// printf-style TrackerPrint (reference rabit.h:129,
+/// rabit-inl.h:202-210).
+#if defined(__GNUC__) || defined(__clang__)
+__attribute__((format(printf, 1, 2)))
+#endif
+inline void TrackerPrintf(const char* fmt, ...) {
+  const int kPrintBuffer = 1 << 10;
+  std::string msg(kPrintBuffer, '\0');
+  va_list args;
+  va_start(args, fmt);
+  vsnprintf(&msg[0], kPrintBuffer, fmt, args);
+  va_end(args);
+  msg.resize(strlen(msg.c_str()));
+  TrackerPrint(msg);
 }
 
 #if defined(__GNUC__) || defined(__clang__)

@@ -64,6 +64,12 @@ int RbtWorldEpoch(void);
  * same buf/len convention as RbtGetProcessorName */
 int RbtCoordAddr(char* buf, size_t* len, size_t max_len);
 
+/* No-op whose address forces the linker to keep this library when a
+ * binding is loaded only through static initializers (reference
+ * RabitLinkTag, c_api.h:156-164):
+ *   static int must_link_rabit_ = RbtLinkTag();  */
+int RbtLinkTag(void);
+
 int RbtBroadcast(void* sendrecvbuf, uint64_t size, int root);
 /* same, with a replay cache key (bootstrap cache) */
 int RbtBroadcastEx(void* sendrecvbuf, uint64_t size, int root,

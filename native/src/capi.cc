@@ -256,4 +256,7 @@ int RbtVersionNumber(void) {
   }
 }
 
+// no-op link anchor (reference RabitLinkTag, c_api.h:156-164)
+int RbtLinkTag(void) { return 0; }
+
 }  // extern "C"

@@ -152,6 +152,9 @@ int main(int argc, char* argv[]) {
   CHECK(rabit::GetWorldSize() == 1);
   CHECK(!rabit::IsDistributed());
   CHECK(!rabit::GetProcessorName().empty());
+  rabit::TrackerPrintf("api_test rank %d of %d\n", rabit::GetRank(),
+                       rabit::GetWorldSize());
+  CHECK(RbtLinkTag() == 0);
 
   TestStreams();
   TestSingleNodeCollectives();
