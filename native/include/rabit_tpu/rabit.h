@@ -243,9 +243,9 @@ inline void TrackerPrintf(const char* fmt, ...) {
   std::string msg(kPrintBuffer, '\0');
   va_list args;
   va_start(args, fmt);
-  vsnprintf(&msg[0], kPrintBuffer, fmt, args);
+  std::vsnprintf(&msg[0], kPrintBuffer, fmt, args);
   va_end(args);
-  msg.resize(strlen(msg.c_str()));
+  msg.resize(std::strlen(msg.c_str()));
   TrackerPrint(msg);
 }
 

@@ -6,16 +6,21 @@ per-row scatter-add the reference's use case feeds into its allreduce
 reformulates the scatter as masked matmuls on the MXU through a
 TWO-LEVEL bin decomposition, bin = hi*128 + lo:
 
-- the [chunk, 128] low-level one-hot (``lo == c``, full lane width —
-  one compare per row x lane, built once and shared by every gradient
-  component) selects each component into the rhs; the [chunk, A]
-  high-level one-hot is the dot's lhs, so
-  out_k[a, c] = sum_rows [hi==a]*[lo==c]*gh_k needs
-  O(chunk * (A + 128)) compares instead of the naive one-hot's
-  O(chunk * nbins), and the dot's N dimension is exactly one lane tile;
+- out_k[a, c] = sum_rows [hi==a]*[lo==c]*gh_k: the [chunk, 128]
+  low-level one-hot (``lo == c``, full lane width) and the [chunk, A]
+  high-level one-hot need O(chunk * (A + 128)) compares instead of the
+  naive one-hot's O(chunk * nbins), and the dot's N dimension is
+  exactly one lane tile;
+- the component values fuse into whichever mask side is NARROWER
+  (hi side when A <= 128, e.g. 8 lanes at 1024 bins), so per-component
+  select work is O(chunk * min(A, 128)) and the value-free wide mask is
+  built once and shared by all components — fusing into the 128-wide
+  side made the 4-component high path ~9x slower than fast instead of
+  the expected ~2x;
 - default ``precision="high"``: gradients ride as four f32 components
   (bf16 hi/lo splits of grad and hess) recombined after the kernel —
-  ~2e-6 relative accuracy at ~20% over the fast path's cost;
+  ~2e-6 relative accuracy at ~2x the fast path's per-component select
+  and dot work (4 components vs 2);
 - ``precision="fast"``: two components (grad, hess) cast to bf16 —
   per-bin relative error ~2e-4 on 2M rows, inside split-finding
   tolerance;
@@ -23,8 +28,11 @@ TWO-LEVEL bin decomposition, bin = hi*128 + lo:
   [chunk, nbins] mask OOM'd v5e's 16 MB scoped vmem at 1024 bins).
 
 Measured on v5e (2M rows, 1024 bins, dispatch-floor-cancelled slope
-timing — see bench.py): high ~4.3 ms, fast ~3.1 ms, XLA ``segment_sum``
-~15 ms; the naive full-width one-hot kernel ran ~7 ms fast / OOM high.
+timing with PRE-STAGED device inputs — see bench.py; earlier rounds
+timed in-loop threefry generation, ~2.8 ms/dataset, alongside the
+kernel): fast ~0.3 ms; the lo-side-fused high path ran ~3.0 ms, which
+motivated the narrow-side fusion; XLA ``segment_sum`` ~15 ms; the naive
+full-width one-hot kernel ran ~7 ms fast / OOM high.
 """
 
 from __future__ import annotations
@@ -74,23 +82,34 @@ def _hist_kernel_body(r: int, cbits: int, atile: int, chunk: int, *refs):
     hi_id = jax.lax.shift_right_logical(bb, cbits)   # bin = hi*C + lo
     lo_id = jax.lax.bitwise_and(bb, cdim - 1)
     iota_c = jax.lax.broadcasted_iota(jnp.int32, (chunk, cdim), 1)
-    # ONE full-lane-width low mask shared by every gh component
     lo_match = lo_id[:, None] == iota_c              # [chunk, 128] bool
     a0 = j * atile
     iota_a = jax.lax.broadcasted_iota(jnp.int32, (chunk, atile), 1) + a0
-    h_mask = (hi_id[:, None] == iota_a).astype(jnp.bfloat16)
+    h_match = hi_id[:, None] == iota_a               # [chunk, atile] bool
     # hist factorizes through the two-level decomposition:
     # out_k[a, c] = sum_rows [hi==a] * [lo==c] * gh_k
     # -> per component ONE [atile, chunk] x [chunk, 128] MXU dot, with
-    # compares O(chunk*(A+C)) instead of O(chunk*nbins); the rhs is a
-    # single select per component against the shared full-width mask
+    # compares O(chunk*(A+C)) instead of O(chunk*nbins). The component
+    # values fuse into WHICHEVER mask side is narrower — per-component
+    # elementwise work is O(chunk*min(A,C)) instead of always paying the
+    # full lane width (fusing into the 128-wide lo side cost the high
+    # path 4 [chunk, 128] selects/chunk and ~9x the fast path's time; at
+    # 1024 bins the hi side is 8 wide). Fusing value*mask stays exact in
+    # bf16: components are bf16-representable and the mask is 0/1. The
+    # value-free mask is built once and shared by all r components.
     # (comp broadcast is f32 [chunk, 1] — Mosaic minor-dim insertion is
     # 32-bit only)
+    hi_narrow = atile <= cdim
+    narrow, wide = (h_match, lo_match) if hi_narrow else (lo_match,
+                                                          h_match)
+    wide_bf = wide.astype(jnp.bfloat16)
     for k in range(r):
         col = comp_refs[k][:][:, None]               # f32 [chunk, 1]
-        rhs = jnp.where(lo_match, col, 0.0).astype(jnp.bfloat16)
+        fused = jnp.where(narrow, col, 0.0).astype(jnp.bfloat16)
+        # out is always [atile, cdim]: the hi-mask operand is the lhs
+        lhs, rhs = (fused, wide_bf) if hi_narrow else (wide_bf, fused)
         out_ref[k] += jax.lax.dot_general(
-            h_mask, rhs, (((0,), (0,)), ((), ())),
+            lhs, rhs, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
 

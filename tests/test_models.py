@@ -47,12 +47,15 @@ def test_local_histogram_padding():
 
 
 @pytest.mark.parametrize("precision", ["fast", "high"])
-def test_local_histogram_pallas_interpret(monkeypatch, precision):
+@pytest.mark.parametrize("nbins", [64, 16640])
+def test_local_histogram_pallas_interpret(monkeypatch, precision, nbins):
     """The pallas kernel (interpret mode on CPU) matches the host oracle
-    at its documented precision, including padding rows."""
+    at its documented precision, including padding rows. nbins=64 takes
+    the values-fused-into-hi-mask branch (atile <= 128); nbins=16640
+    (130 hi-groups > one 128-lane tile) takes the lo-side branch."""
     monkeypatch.setenv("RABIT_PALLAS_INTERPRET", "1")
     from rabit_tpu.ops.pallas_kernels import histogram_tpu, _CHUNK
-    n, nbins = 10_000, 64
+    n = 10_000
     grad, hess, bins = (a[0] for a in H.make_inputs(n, nbins, p=1, seed=5))
     pad = (-n) % _CHUNK
     b = np.concatenate([bins, np.full(pad, nbins, bins.dtype)])
