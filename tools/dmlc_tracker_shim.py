@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Minimal dmlc-tracker-protocol server: launches the REFERENCE rabit
+binaries (built out-of-tree from /root/reference) so their speed_test
+can run head-to-head against ours on the same host.
+
+The reference's worker-side protocol (observed at
+/root/reference/src/allreduce_base.cc:222-441; the real server lives in
+dmlc-core, not in this image):
+
+  worker -> tracker: int32 magic 0xff99        | tracker echoes magic
+  worker -> tracker: int32 rank (-1 = unknown), int32 world_size,
+                     str task_id               | str = int32 len + bytes
+  worker -> tracker: str cmd                   | start/recover/print/shutdown
+  [cmd == start]
+  tracker -> worker: int32 rank, parent_rank, world_size,
+                     num_neighbors, neighbors..., prev_rank, next_rank
+  loop: worker -> tracker: int32 ngood, good ranks...
+        tracker -> worker: int32 num_conn, num_accept,
+                           (str host, int32 port, int32 rank) x num_conn
+        worker -> tracker: int32 num_error     | repeat while != 0
+  worker -> tracker: int32 listen_port
+
+Workers are served strictly in rank order: rank k connects to its
+already-served lower-rank neighbors (ports known) and accepts from
+higher-rank ones — the same sequencing dmlc-core's tracker enforces
+with its wait_conn map.
+
+Usage: python tools/dmlc_tracker_shim.py -n 4 prog [args...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+
+MAGIC = 0xff99
+
+
+def _recv_all(conn: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("worker closed connection")
+        buf += chunk
+    return buf
+
+
+def _recv_int(conn) -> int:
+    return struct.unpack("@i", _recv_all(conn, 4))[0]
+
+
+def _send_int(conn, v: int) -> None:
+    conn.sendall(struct.pack("@i", v))
+
+
+def _recv_str(conn) -> str:
+    return _recv_all(conn, _recv_int(conn)).decode()
+
+
+def _send_str(conn, s: str) -> None:
+    _send_int(conn, len(s))
+    conn.sendall(s.encode())
+
+
+class RefTracker:
+    """Serves one generation of `n` reference workers (no restarts —
+    this shim exists for the speed benchmark, not recovery tests)."""
+
+    def __init__(self, nworkers: int):
+        self.n = nworkers
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("127.0.0.1", 0))
+        self.sock.listen(nworkers + 8)
+        self.port = self.sock.getsockname()[1]
+        self.ports = {}          # rank -> listen port
+        self.shutdown_seen = 0
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+
+    def env(self) -> dict:
+        return {"DMLC_TRACKER_URI": "127.0.0.1",
+                "DMLC_TRACKER_PORT": str(self.port),
+                "DMLC_NUM_WORKER": str(self.n)}
+
+    def _neighbors(self, r: int):
+        """Binary-heap tree; parent of 0 is -1."""
+        parent = (r - 1) // 2 if r else -1
+        kids = [c for c in (2 * r + 1, 2 * r + 2) if c < self.n]
+        return parent, ([parent] if r else []) + kids
+
+    def _serve_start(self, conn, rank_counter):
+        rank = rank_counter[0]
+        rank_counter[0] += 1
+        parent, neigh = self._neighbors(rank)
+        prev_r = (rank - 1) % self.n if self.n > 1 else -1
+        next_r = (rank + 1) % self.n if self.n > 1 else -1
+        _send_int(conn, rank)
+        _send_int(conn, parent)
+        _send_int(conn, self.n)
+        _send_int(conn, len(neigh))
+        for nr in neigh:
+            _send_int(conn, nr)
+        _send_int(conn, prev_r)
+        _send_int(conn, next_r)
+        # ranks this worker must dial: every already-served peer it
+        # shares a tree or ring edge with
+        linked = set(neigh) | {prev_r, next_r}
+        linked.discard(-1)
+        to_conn = sorted(x for x in linked if x < rank)
+        num_accept = len([x for x in linked if x > rank])
+        while True:
+            good = {_recv_int(conn) for _ in range(_recv_int(conn))}
+            # only the not-yet-established links: re-sending an already
+            # good peer trips the worker's "Override a link that is
+            # active" assert (allreduce_base.cc:376) on retry rounds
+            pending = [r for r in to_conn if r not in good]
+            _send_int(conn, len(pending))
+            _send_int(conn, num_accept)
+            for pr in pending:
+                _send_str(conn, "127.0.0.1")
+                _send_int(conn, self.ports[pr])
+                _send_int(conn, pr)
+            if _recv_int(conn) == 0:      # num_error
+                break
+        self.ports[rank] = _recv_int(conn)
+
+    def _serve(self):
+        rank_counter = [0]
+        while self.shutdown_seen < self.n:
+            conn, _ = self.sock.accept()
+            magic = _recv_int(conn)
+            assert magic == MAGIC, f"bad magic {magic:#x}"
+            _send_int(conn, MAGIC)
+            _recv_int(conn)               # advertised rank
+            _recv_int(conn)               # advertised world
+            _recv_str(conn)               # task id
+            cmd = _recv_str(conn)
+            if cmd == "start":
+                self._serve_start(conn, rank_counter)
+            elif cmd == "print":
+                print(f"[ref-tracker] {_recv_str(conn)}", end="",
+                      flush=True)
+            elif cmd == "shutdown":
+                self.shutdown_seen += 1
+            else:                         # recover unsupported here
+                raise RuntimeError(f"shim got cmd {cmd!r}")
+            conn.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", type=int, required=True)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    tr = RefTracker(args.n)
+    tr.thread.start()
+    procs = []
+    for i in range(args.n):
+        env = dict(os.environ, DMLC_TASK_ID=str(i), **tr.env())
+        procs.append(subprocess.Popen(args.cmd, env=env))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,27 +35,34 @@ def main() -> None:
                 "interpret_mode": False}
 
     # --- histogram kernel (compiled Mosaic) -------------------------------
+    # nbins=1024 takes the values-fused-into-hi-mask branch (8 hi
+    # groups); nbins=16640 (130 groups > one lane tile) takes the
+    # lo-side branch — both must prove out compiled, not just in the
+    # CI interpret tests.
     from rabit_tpu.models import histogram as H
-    n, nbins = 1 << 20, 1024
-    grad, hess, bins = H.make_inputs(n, nbins, p=1, seed=3)
-    g, h, b = grad[0], hess[0], bins[0]
-    for precision in ("high", "fast"):
-        t0 = time.perf_counter()
-        out = np.asarray(H.local_histogram(
-            jnp.asarray(g), jnp.asarray(h), jnp.asarray(b), nbins,
-            method="pallas", precision=precision))
-        dt = time.perf_counter() - t0
-        want = H.host_histogram(g, h, b, nbins)
-        atol = (2e-3 if precision == "high"
-                else 8 * 2.0 ** -9 * float(np.sqrt(n / nbins)))
-        ok = bool(np.allclose(out, want, rtol=2e-2, atol=atol))
-        err = float(np.abs(out - want).max())
-        evidence[f"histogram_{precision}"] = {
-            "rows": n, "nbins": nbins, "compile+run_s": round(dt, 3),
-            "max_abs_err": err, "correct": ok}
-        print(f"histogram[{precision}]: correct={ok} "
-              f"max_err={err:.5f}", flush=True)
-        assert ok, f"histogram {precision} wrong on hardware"
+    n = 1 << 20
+    for nbins in (1024, 16640):
+        grad, hess, bins = H.make_inputs(n, nbins, p=1, seed=3)
+        g, h, b = grad[0], hess[0], bins[0]
+        for precision in ("high", "fast"):
+            t0 = time.perf_counter()
+            out = np.asarray(H.local_histogram(
+                jnp.asarray(g), jnp.asarray(h), jnp.asarray(b), nbins,
+                method="pallas", precision=precision))
+            dt = time.perf_counter() - t0
+            want = H.host_histogram(g, h, b, nbins)
+            atol = (2e-3 if precision == "high"
+                    else 8 * 2.0 ** -9 * float(np.sqrt(n / nbins)))
+            ok = bool(np.allclose(out, want, rtol=2e-2, atol=atol))
+            err = float(np.abs(out - want).max())
+            key = (f"histogram_{precision}" if nbins == 1024
+                   else f"histogram_{precision}_nbins{nbins}")
+            evidence[key] = {
+                "rows": n, "nbins": nbins, "compile+run_s": round(dt, 3),
+                "max_abs_err": err, "correct": ok}
+            print(f"histogram[{precision}, nbins={nbins}]: correct={ok} "
+                  f"max_err={err:.5f}", flush=True)
+            assert ok, f"histogram {precision}/{nbins} wrong on hardware"
 
     # --- flash block kernel: forward + backward (custom VJP) --------------
     from rabit_tpu.parallel.ring_attention import (

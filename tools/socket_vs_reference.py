@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Head-to-head socket-engine benchmark: OUR C++ speed_test vs the
+REFERENCE's own test/speed_test.cc, same host, same world sizes, same
+payloads — the reference's headline collective benchmark run on its own
+harness (BASELINE.json configs; /root/reference/test/speed_test.cc).
+
+The reference is built OUT-OF-TREE (its source stays read-only) against
+a ~40-line stub of dmlc-core's ``dmlc/io.h`` (the only external header
+it needs; dmlc-core is not in this image), and launched through
+``tools/dmlc_tracker_shim.py``. Ours runs under its normal tracker.
+
+Metric normalization: MB/s = payload_bytes / mean_seconds_per_op
+(cluster mean), decimal MB. Payload per op: allreduce moves
+ndata * sizeof(float) on both sides; broadcast moves ndata * 4 bytes in
+ours (float buffer) but ndata * 1 bytes in the reference (std::string;
+test/speed_test.cc passes sizeof(char) to its stats printer) — rows
+record the byte counts, and equal-byte broadcast comparisons come from
+cross-referencing grid rows (our ndata=N vs reference ndata=4N). The
+reference broadcasts from a random root per rep while ours uses root 0
+— symmetric cost on a balanced tree; noted for completeness.
+
+Writes SOCKET_VS_REF_<ts>.json at the repo root.
+
+Usage: python tools/socket_vs_reference.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+
+DMLC_IO_STUB = """\
+#ifndef DMLC_IO_H_
+#define DMLC_IO_H_
+#include <cstddef>
+#include <cstring>
+#include <string>
+namespace dmlc {
+class Stream {
+ public:
+  virtual size_t Read(void* ptr, size_t size) = 0;
+  virtual void Write(const void* ptr, size_t size) = 0;
+  virtual ~Stream() {}
+};
+class SeekStream : public Stream {
+ public:
+  virtual void Seek(size_t pos) = 0;
+  virtual size_t Tell(void) = 0;
+};
+class Serializable {
+ public:
+  virtual ~Serializable() {}
+  virtual void Load(Stream* fi) = 0;
+  virtual void Save(Stream* fo) const = 0;
+};
+}  // namespace dmlc
+#endif
+"""
+
+DMLC_BASE_STUB = """\
+#ifndef DMLC_BASE_H_
+#define DMLC_BASE_H_
+#define DMLC_ENABLE_STD_THREAD 1
+#endif
+"""
+
+
+def build_reference(workdir: str) -> str:
+    """Compile the reference's socket engine + speed_test out-of-tree.
+    Returns the binary path."""
+    os.makedirs(os.path.join(workdir, "dmlc"), exist_ok=True)
+    os.makedirs(os.path.join(workdir, "include", "dmlc"), exist_ok=True)
+    os.makedirs(os.path.join(workdir, "x"), exist_ok=True)
+    with open(os.path.join(workdir, "dmlc", "io.h"), "w") as f:
+        f.write(DMLC_IO_STUB)
+    # thread_local.h includes "../include/dmlc/base.h" relative to an
+    # -I root; the x/ dir makes that path resolve inside workdir
+    with open(os.path.join(workdir, "include", "dmlc", "base.h"),
+              "w") as f:
+        f.write(DMLC_BASE_STUB)
+    objs = []
+    for src in ("allreduce_base", "allreduce_robust", "engine"):
+        obj = os.path.join(workdir, f"{src}.o")
+        subprocess.run(
+            ["g++", "-c", "-O3", "-std=c++11",
+             f"-I{REF}/include", f"-I{workdir}", f"-I{workdir}/x",
+             f"{REF}/src/{src}.cc", "-o", obj],
+            check=True, capture_output=True)
+        objs.append(obj)
+    binary = os.path.join(workdir, "ref_speed_test")
+    subprocess.run(
+        ["g++", "-O3", "-std=c++11", f"-I{REF}/include", f"-I{workdir}",
+         f"{REF}/test/speed_test.cc", *objs, "-o", binary,
+         "-pthread", "-lm"],
+        check=True, capture_output=True)
+    return binary
+
+
+def run_ours(world: int, ndata: int, nrep: int) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-m", "rabit_tpu.tracker.launch",
+         "-n", str(world), os.path.join(REPO, "native", "build",
+                                        "speed_test"),
+         f"ndata={ndata}", f"nrep={nrep}"],
+        capture_output=True, text=True, timeout=900, cwd=REPO,
+        env=dict(os.environ, PYTHONPATH=REPO))
+    assert out.returncode == 0, out.stderr[-2000:]
+    res = {}
+    for name, key in (("allreduce.sum", "sum"), ("allreduce.max", "max"),
+                      ("broadcast", "bcast")):
+        m = re.search(rf"{re.escape(name)}\s+mean\s+([\d.]+)s.*?"
+                      rf"([\d.]+) MB/s", out.stdout)
+        assert m, (name, out.stdout[-2000:])
+        res[key] = float(m.group(2))
+    return res
+
+
+def run_ref(binary: str, world: int, ndata: int, nrep: int) -> dict:
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools",
+                                      "dmlc_tracker_shim.py"),
+         "-n", str(world), binary, str(ndata), str(nrep)],
+        capture_output=True, text=True, timeout=900, cwd=REPO)
+    assert out.returncode == 0, out.stderr[-2000:]
+    res = {}
+    for name, key, elem_bytes in (("sum_tdiff", "sum", 4),
+                                  ("max_tdiff", "max", 4),
+                                  ("bcast_tdiff", "bcast", 1)):
+        m = re.search(rf"{name}: mean=([\d.e+-]+)", out.stdout)
+        assert m, (name, out.stdout[-2000:])
+        mean_per_rep = float(m.group(1)) / nrep
+        res[key] = ndata * elem_bytes / mean_per_rep / 1e6
+    return res
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="one config only (CI-sized)")
+    args = ap.parse_args()
+    grid = ([(4, 1_000_000)] if args.quick else
+            [(2, 100_000), (2, 1_000_000), (2, 4_000_000),
+             (4, 100_000), (4, 1_000_000), (4, 4_000_000),
+             (8, 100_000), (8, 1_000_000), (8, 4_000_000)])
+    nrep = 5 if args.quick else 10
+    with tempfile.TemporaryDirectory() as wd:
+        binary = build_reference(wd)
+        rows = []
+        for world, ndata in grid:
+            ours = run_ours(world, ndata, nrep)
+            ref = run_ref(binary, world, ndata, nrep)
+            row = {"world": world, "ndata": ndata,
+                   "payload_bytes": {
+                       "allreduce": ndata * 4,
+                       "bcast_ours": ndata * 4,
+                       "bcast_reference": ndata},
+                   "ours_MBps": ours, "reference_MBps": ref,
+                   "speedup": {k: round(ours[k] / ref[k], 2)
+                               for k in ours}}
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ")
+    payload = {
+        "benchmark": "reference test/speed_test.cc vs ours, same host "
+                     "(loopback TCP), nrep=%d" % nrep,
+        "metric": "payload_bytes / cluster-mean seconds per op, "
+                  "decimal MB/s",
+        "rows": rows,
+        "timestamp_utc": ts,
+    }
+    path = os.path.join(REPO, f"SOCKET_VS_REF_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
