@@ -131,6 +131,20 @@ class RefTracker:
         self.ports[rank] = _recv_int(conn)
 
     def _serve(self):
+        # Loud failure: a protocol surprise (e.g. a crashed worker
+        # reconnecting with cmd "recover", which this benchmark shim
+        # does not support) must not strand the remaining workers in
+        # blocking tracker I/O with a silently dead daemon thread.
+        try:
+            self._serve_loop()
+        except BaseException:
+            import traceback
+            traceback.print_exc()
+            print("[ref-tracker] fatal: aborting benchmark run",
+                  file=sys.stderr, flush=True)
+            os._exit(2)
+
+    def _serve_loop(self):
         rank_counter = [0]
         while self.shutdown_seen < self.n:
             conn, _ = self.sock.accept()

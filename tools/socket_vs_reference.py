@@ -16,8 +16,9 @@ ours (float buffer) but ndata * 1 bytes in the reference (std::string;
 test/speed_test.cc passes sizeof(char) to its stats printer) — rows
 record the byte counts, and equal-byte broadcast comparisons come from
 cross-referencing grid rows (our ndata=N vs reference ndata=4N). The
-reference broadcasts from a random root per rep while ours uses root 0
-— symmetric cost on a balanced tree; noted for completeness.
+reference broadcasts from a random root per rep while ours rotates the
+root (rep % world, native/test/speed_test.cc) — both symmetric over a
+balanced tree; noted for completeness.
 
 Writes SOCKET_VS_REF_<ts>.json at the repo root.
 
@@ -87,21 +88,24 @@ def build_reference(workdir: str) -> str:
     with open(os.path.join(workdir, "include", "dmlc", "base.h"),
               "w") as f:
         f.write(DMLC_BASE_STUB)
+    def cc(cmd):
+        out = subprocess.run(cmd, capture_output=True, text=True)
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"reference build failed: {' '.join(cmd)}\n"
+                f"{out.stderr[-4000:]}")
+
     objs = []
     for src in ("allreduce_base", "allreduce_robust", "engine"):
         obj = os.path.join(workdir, f"{src}.o")
-        subprocess.run(
-            ["g++", "-c", "-O3", "-std=c++11",
-             f"-I{REF}/include", f"-I{workdir}", f"-I{workdir}/x",
-             f"{REF}/src/{src}.cc", "-o", obj],
-            check=True, capture_output=True)
+        cc(["g++", "-c", "-O3", "-std=c++11",
+            f"-I{REF}/include", f"-I{workdir}", f"-I{workdir}/x",
+            f"{REF}/src/{src}.cc", "-o", obj])
         objs.append(obj)
     binary = os.path.join(workdir, "ref_speed_test")
-    subprocess.run(
-        ["g++", "-O3", "-std=c++11", f"-I{REF}/include", f"-I{workdir}",
-         f"{REF}/test/speed_test.cc", *objs, "-o", binary,
-         "-pthread", "-lm"],
-        check=True, capture_output=True)
+    cc(["g++", "-O3", "-std=c++11", f"-I{REF}/include", f"-I{workdir}",
+        f"{REF}/test/speed_test.cc", *objs, "-o", binary,
+        "-pthread", "-lm"])
     return binary
 
 
