@@ -7,6 +7,7 @@ assertion here: CI hosts are noisy; the committed artifact carries the
 measured grid)."""
 
 import os
+import shutil
 import tempfile
 
 import pytest
@@ -14,10 +15,12 @@ import pytest
 from tests.test_integration import LIB, ROOT
 
 REF = "/root/reference"
+SPEED = os.path.join(ROOT, "native", "build", "speed_test")
 
 pytestmark = pytest.mark.skipif(
-    not (os.path.isdir(REF) and os.path.isfile(LIB)),
-    reason="reference tree or native build unavailable")
+    not (os.path.isdir(REF) and os.path.isfile(LIB)
+         and os.path.isfile(SPEED) and shutil.which("g++")),
+    reason="reference tree, native build, or g++ unavailable")
 
 
 def test_reference_builds_and_runs_under_shim():
