@@ -13,19 +13,131 @@ inputs -> replicated histogram), vs the host-CPU numpy baseline doing the
 same local histogram (the compute the reference would feed its
 allreduce).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"correct"} — plus {"status", "cached_from"} when the run degraded (device
+unreachable / deadline / SIGTERM) and the values come from the newest
+committed BENCH_LOCAL_* artifact or a partial live measurement.
 """
 
 from __future__ import annotations
 
 import datetime
+import glob
 import json
 import os
+import signal
 import sys
+import threading
 import time
 
 _REPO = __file__.rsplit("/", 1)[0]
 sys.path.insert(0, _REPO)
+
+
+# ---------------------------------------------------------------------------
+# Guaranteed-emission machinery (VERDICT r3 #1). The driver runs this script
+# under a timeout and records whatever single JSON line lands on stdout; three
+# rounds in a row the tunnel was down at capture time and the process died
+# mid-retry with nothing parseable. Rules now:
+#   - exactly ONE JSON line is ever printed (guarded by _EMIT_LOCK);
+#   - SIGTERM (what `timeout` sends) triggers an immediate best-effort line;
+#   - an internal deadline (RABIT_BENCH_DEADLINE_S) beats any external
+#     timeout to the punch;
+#   - when no fresh measurement exists, the line carries the values and
+#     timestamp of the newest committed BENCH_LOCAL_* artifact plus a
+#     "status" field naming the degradation, so cached numbers can never be
+#     mistaken for a live run.
+# ---------------------------------------------------------------------------
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+_BEST_LINE: dict | None = None  # updated as soon as a headline is measured
+
+
+def _newest_local_artifact() -> dict | None:
+    paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_LOCAL_*.json")))
+    for path in reversed(paths):
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            continue
+    return None
+
+
+def _fallback_line(status: str) -> dict:
+    cached = _newest_local_artifact()
+    if cached is None:  # pragma: no cover - repo always carries artifacts
+        return {"metric": "histogram_allreduce_throughput", "value": 0.0,
+                "unit": "GB/s", "vs_baseline": 0.0, "status": status,
+                "cached_from": None}
+    return {
+        "metric": cached.get("metric", "histogram_allreduce_throughput"),
+        "value": cached.get("value", 0.0),
+        "unit": cached.get("unit", "GB/s"),
+        "vs_baseline": cached.get("vs_baseline", 0.0),
+        "correct": cached.get("correct"),
+        "status": status,
+        "cached_from": cached.get("timestamp_utc"),
+    }
+
+
+def _emit_once(line: dict, rc: int | None = None) -> None:
+    """Print the one-and-only JSON line (idempotent; thread/signal safe).
+    With rc not None, also hard-exit — used from the SIGTERM handler and
+    the deadline watchdog, where returning would let the process die (or
+    keep hanging) before stdout reaches the driver. The exit paths must
+    NOT block on _EMIT_LOCK: the SIGTERM handler runs on the main thread,
+    and if the interrupted frame is itself inside _emit_once holding the
+    lock, a blocking acquire would deadlock the process with the line
+    still unflushed."""
+    global _EMITTED
+    acquired = (_EMIT_LOCK.acquire(blocking=False) if rc is not None
+                else _EMIT_LOCK.acquire())
+    if acquired:
+        try:
+            if not _EMITTED:
+                _EMITTED = True
+                sys.stdout.write(json.dumps(line) + "\n")
+                sys.stdout.flush()
+        finally:
+            _EMIT_LOCK.release()
+    else:
+        # Lock held by the frame this signal interrupted: an emission is
+        # already in flight. Push any buffered bytes out before exiting
+        # (os._exit skips interpreter-level flushing).
+        try:
+            sys.stdout.flush()
+        except Exception:  # pragma: no cover - nothing left to do
+            pass
+    if rc is not None:
+        os._exit(rc)
+
+
+def _degraded(status: str) -> dict:
+    """Best line available right now: a live headline measured earlier in
+    this run if one exists, else the newest committed artifact."""
+    if _BEST_LINE is not None:
+        return dict(_BEST_LINE, status=status + "_partial")
+    return _fallback_line(status)
+
+
+def _install_guards() -> None:
+    def on_term(signum, frame):  # pragma: no cover - signal path
+        print("# SIGTERM: emitting best-effort line", file=sys.stderr,
+              flush=True)
+        _emit_once(_degraded("killed_mid_run"), rc=0)
+
+    signal.signal(signal.SIGTERM, on_term)
+
+    deadline = float(os.environ.get("RABIT_BENCH_DEADLINE_S", "900"))
+
+    def watchdog():  # pragma: no cover - timing path
+        time.sleep(deadline)
+        print(f"# internal deadline ({deadline:.0f}s) hit: emitting "
+              "best-effort line", file=sys.stderr, flush=True)
+        _emit_once(_degraded("deadline_exceeded"), rc=0)
+
+    threading.Thread(target=watchdog, daemon=True).start()
 
 
 # Slope-measurement sizing: k iterations cycle over a pool of K_STAGE
@@ -98,7 +210,11 @@ def _probe_once(timeout_s: float) -> str:
     thread we can abandon. A hung dispatch leaves that thread wedged in
     the runtime — harmless for the probe (each attempt uses a fresh
     thread; success only needs one attempt to complete)."""
-    import threading
+    if os.environ.get("RABIT_BENCH_FAKE_TUNNEL_DOWN") == "1":
+        # test hook: lets CI exercise the degraded-emission path
+        # deterministically (tests/test_bench_smoke.py) — a real outage
+        # can't be staged on demand
+        return "simulated outage (RABIT_BENCH_FAKE_TUNNEL_DOWN)"
     ok = threading.Event()
     err: list = []
 
@@ -131,9 +247,12 @@ def _probe_device() -> None:
     fail-fast: the tunnel's outages are transient (minutes-scale), and a
     bench run that gives up after one probe loses the round's only
     driver-captured perf evidence. Budget/backoff via
-    RABIT_BENCH_PROBE_BUDGET_S (default 1800) — probes every 60s
-    doubling to 300s until the budget is spent, then fails loudly."""
-    budget = float(os.environ.get("RABIT_BENCH_PROBE_BUDGET_S", "1800"))
+    RABIT_BENCH_PROBE_BUDGET_S (default 240 — it must stay well under
+    both RABIT_BENCH_DEADLINE_S and any external timeout, or the driver
+    kills us mid-retry as in round 3). On an exhausted budget the run
+    degrades to the cached-artifact line (status "tunnel_down") instead
+    of dying unparsed."""
+    budget = float(os.environ.get("RABIT_BENCH_PROBE_BUDGET_S", "240"))
     deadline = time.monotonic() + budget
     interval, attempt = 60.0, 0
     while True:
@@ -146,9 +265,10 @@ def _probe_device() -> None:
             return
         remaining = deadline - time.monotonic()
         if remaining <= 0:
-            raise RuntimeError(
-                f"device unreachable after {attempt} probes over "
-                f"{budget:.0f}s: {msg}")
+            print(f"# device unreachable after {attempt} probes over "
+                  f"{budget:.0f}s: {msg}; emitting cached-artifact line",
+                  file=sys.stderr, flush=True)
+            _emit_once(_fallback_line("tunnel_down"), rc=0)
         print(f"# probe {attempt} failed ({msg}); retrying in "
               f"{min(interval, remaining):.0f}s "
               f"({remaining:.0f}s budget left)", file=sys.stderr, flush=True)
@@ -174,6 +294,12 @@ def _write_local_artifact(payload: dict) -> None:
 
 
 def main() -> None:
+    # Guards first — BEFORE `import jax`: when the tunnel is wedged the
+    # axon sitecustomize can hang that import itself, and guards
+    # installed after it would never arm (the exact round-3 zero-stdout
+    # failure). _install_guards has no jax dependency.
+    _install_guards()
+
     import jax
     import numpy as np
 
@@ -259,6 +385,18 @@ def main() -> None:
     nbytes = p * n * 12  # grad f32 + hess f32 + bins i32 per row
     dev_gbps = nbytes / t_dev / 1e9
 
+    # Headline is in hand: register it so a deadline/SIGTERM mid-curve
+    # still publishes THIS run's number (flagged *_partial), not a
+    # cached one. vs_baseline/correct are filled in below.
+    global _BEST_LINE
+    _BEST_LINE = {
+        "metric": "histogram_allreduce_throughput",
+        "value": round(dev_gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": None,
+        "correct": None,
+    }
+
     # bandwidth-vs-size curve for the headline variant (artifact only).
     # The main staged pool is dead from here — free it before staging
     # curve pools (the nn=1<<22 pool is 2x the main one; holding both
@@ -309,12 +447,17 @@ def main() -> None:
           f"headline={best_method}/high t_dev={t_dev*1e3:.2f}ms "
           f"t_host={t_host*1e3:.2f}ms correct={ok} detail={detail}",
           file=sys.stderr)
+    # "correct" rides the headline line so the driver/CI can gate on a
+    # numerically-broken path directly (advisor r3) instead of grepping
+    # stderr for the spot-check verdict.
     line = {
         "metric": "histogram_allreduce_throughput",
         "value": round(dev_gbps, 3),
         "unit": "GB/s",
         "vs_baseline": round(dev_gbps / host_gbps, 3),
+        "correct": bool(ok),
     }
+    _BEST_LINE = dict(line)
     if not smoke:  # CI smoke must not shed artifacts into the repo
         _write_local_artifact(dict(
             line,
@@ -334,7 +477,7 @@ def main() -> None:
                         "floor; staging keeps threefry generation out "
                         "of the timed region)",
             correct=bool(ok)))
-    print(json.dumps(line))
+    _emit_once(line)
 
 
 if __name__ == "__main__":

@@ -35,13 +35,43 @@ def test_bench_smoke_contract():
     # the contract: the LAST stdout line is the one JSON result line
     line = out.stdout.decode().strip().splitlines()[-1]
     res = json.loads(line)
-    assert set(res) == {"metric", "value", "unit", "vs_baseline"}
+    assert set(res) == {"metric", "value", "unit", "vs_baseline", "correct"}
     assert res["metric"] == "histogram_allreduce_throughput"
     assert res["unit"] == "GB/s"
     assert res["value"] > 0
     assert res["vs_baseline"] > 0
+    # the numeric spot check (distributed path vs host oracle) rides the
+    # result line itself so the driver/CI can gate on it directly
+    assert res["correct"] is True
     # smoke runs must not shed BENCH_LOCAL artifacts into the repo
     assert b"BENCH_LOCAL" not in out.stderr
-    # the bench's own numeric spot check (distributed path vs host
-    # oracle) must have passed, not merely been printed
-    assert b"correct=True" in out.stderr
+
+
+def test_bench_degrades_to_cached_line_when_tunnel_down():
+    """VERDICT r3 #1: with the device unreachable, bench.py must still
+    emit one machine-parseable JSON line (cached newest BENCH_LOCAL_*
+    values, flagged with status=tunnel_down) and exit 0 — never die
+    mid-retry with nothing on stdout."""
+    env = dict(os.environ)
+    env.update({
+        "RABIT_BENCH_FAKE_TUNNEL_DOWN": "1",
+        "RABIT_BENCH_PROBE_BUDGET_S": "0",
+        "JAX_PLATFORMS": "cpu",
+    })
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p) or ROOT
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py")],
+        capture_output=True, timeout=120, env=env, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout.decode()[-2000:],
+                                 out.stderr.decode()[-2000:])
+    lines = out.stdout.decode().strip().splitlines()
+    assert len(lines) == 1, lines  # exactly ONE line, ever
+    res = json.loads(lines[0])
+    assert res["status"] == "tunnel_down"
+    assert res["metric"] == "histogram_allreduce_throughput"
+    assert res["unit"] == "GB/s"
+    # the repo carries committed artifacts, so the cached values are real
+    assert res["value"] > 0
+    assert res["cached_from"]
