@@ -158,49 +158,16 @@ assert K_STAGE & (K_STAGE - 1) == 0, "K_STAGE must be a power of two"
 
 
 def _slope_bench(fn):
-    """True device time per dataset via the SLOPE between two batch
-    sizes run inside single dispatches. Measurement notes for this
-    tunnelled-TPU environment (all measured, see tools/ notes):
-    - ONE dispatch+fetch costs ~65-80 ms REGARDLESS of payload — naive
-      per-call or chained-call timing measures the tunnel, not the
-      device (rounds 1-2 did exactly that);
-    - host-staged inputs also stream slowly, so datasets are generated
-      on-device (jax.random) and STAGED BEFORE timing — the realistic
-      shape anyway: XGBoost's gradients are produced on-device by the
-      predict/loss pass of the previous round, so the workload's inputs
-      are device-resident (and threefry generation measurably dominates
-      the kernel if left inside the timed program);
-    - fn(K, salt) must run K dataset-iterations in one jitted dispatch
-      (cycling a staged pool — see K_STAGE); the slope
-      (T(K_BIG) - T(K_SMALL)) / (K_BIG - K_SMALL) cancels the fixed
-      dispatch+fetch cost; best-of-2 per point shields against RPC
-      latency spikes (fresh seeds each — the runtime memoizes
-      (executable, inputs) -> result)."""
-    import numpy as np
-
-    def timed(k, seed):
-        np.asarray(fn(k, seed))  # compile + warm
-        best = float("inf")
-        for rep in range(2):
-            t0 = time.perf_counter()
-            np.asarray(fn(k, seed + 1 + rep))
-            best = min(best, time.perf_counter() - t0)
-        return best
-
-    for attempt in range(3):
-        t_small = timed(K_SMALL, 100 + 10 * attempt)
-        t_big = timed(K_BIG, 200 + 10 * attempt)
-        # sanity: the big batch must cost measurably more than the small
-        # one, or the "slope" is noise (a latency spike landing on the
-        # small point would otherwise publish an absurd throughput)
-        if t_big > t_small * 1.2:
-            return (t_big - t_small) / (K_BIG - K_SMALL)
-        print(f"# non-monotonic slope point (t{K_SMALL}={t_small:.3f}s "
-              f"t{K_BIG}={t_big:.3f}s), remeasuring", file=sys.stderr,
-              flush=True)
-    raise RuntimeError(
-        f"slope measurement unstable after 3 attempts "
-        f"(t{K_SMALL}={t_small:.3f}s t{K_BIG}={t_big:.3f}s)")
+    """True device time per dataset via the shared slope methodology
+    (``rabit_tpu.utils.slope``): fn(K, salt) runs K dataset-iterations
+    in one jitted dispatch cycling a pre-staged pool (see K_STAGE);
+    the K_SMALL->K_BIG slope cancels the ~70 ms tunnel dispatch floor.
+    Datasets are STAGED BEFORE timing — the realistic shape anyway:
+    XGBoost's gradients come from the previous round's on-device predict
+    pass, and in-loop threefry generation measurably dominated the
+    kernel in rounds 1-2's numbers."""
+    from rabit_tpu.utils.slope import slope_time
+    return slope_time(fn, K_SMALL, K_BIG)
 
 
 def _probe_once(timeout_s: float) -> str:

@@ -56,8 +56,22 @@ void RobustComm::InitAfterException() {
 }
 
 void RobustComm::Shutdown() {
-  // two-phase exit like the reference (allreduce_robust.cc:54-75): make
-  // sure nobody is mid-recovery needing us before links drop
+  // Two-phase consensus exit (reference allreduce_robust.cc:54-67): a
+  // rank that finished its last iteration must NOT drop links while a
+  // respawned straggler still needs its result log or checkpoint.
+  // Phase 1 is a pseudo-checkpoint fence: loop in consensus rounds —
+  // serving checkpoint loads (kLoadCheck) and seq replays (diff-seq)
+  // for laggards — until the whole world holds the fence flag at the
+  // same seq. Only then is it safe to drop the recovery state. Phase 2
+  // (ack) keeps links up until everyone has passed phase 1, so no rank
+  // can observe a half-shut-down world and misread it as a failure.
+  if (is_distributed() && world_ > 1) {
+    RecoverExec(nullptr, 0, kCheckPoint, seq_counter_);
+    result_log_.clear();
+    seq_counter_ = 0;
+    bootstrap_cache_.clear();
+    RecoverExec(nullptr, 0, kCheckAck, seq_counter_);
+  }
   Comm::Shutdown();
 }
 

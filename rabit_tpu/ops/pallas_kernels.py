@@ -237,18 +237,132 @@ def _flash_block_body(has_mask, sm_scale, *refs):
         dot(p.astype(v_ref.dtype), v_ref[0], ((1,), (0,)))
 
 
+def _flash_bwd_body(has_mask, sm_scale, *refs):
+    """Fused recompute-style backward of one online-softmax block step.
+
+    Recomputes s/p from the saved block inputs (the flash-attention
+    memory trade), then applies the exact VJP of ``_block_update`` —
+    including jax's tie semantics for the two max ops: ``jnp.maximum``
+    splits a tie 50/50 (lax ``_balanced_eq``) and ``reduce_max`` divides
+    the cotangent equally among tied lanes — so fused gradients are
+    bit-for-bit the same math as differentiating the jnp twin. Five MXU
+    dots per head (s, dp, dv, dq, dk); everything else is VPU
+    elementwise.
+    """
+    if has_mask:
+        (q_ref, k_ref, v_ref, m_ref, l_ref, o_ref, mask_ref,
+         cm_ref, cl_ref, co_ref,
+         dq_ref, dk_ref, dv_ref, dm_ref, dl_ref, do_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, m_ref, l_ref, o_ref,
+         cm_ref, cl_ref, co_ref,
+         dq_ref, dk_ref, dv_ref, dm_ref, dl_ref, do_ref) = refs
+    dot = lambda a, b, dims: jax.lax.dot_general(  # noqa: E731
+        a, b, (dims, ((), ())), preferred_element_type=jnp.float32)
+    q, k, v, o, co = q_ref[0], k_ref[0], v_ref[0], o_ref[0], co_ref[0]
+    m, l = m_ref[0][:, 0], l_ref[0][:, 0]
+    cm, cl = cm_ref[0][:, 0], cl_ref[0][:, 0]
+
+    # --- recompute the forward's s / m_new / alpha / p ---
+    s = dot(q, k, ((1,), (1,))) * sm_scale                   # [T, S] f32
+    if has_mask:
+        masked = mask_ref[:] != 0
+        s = jnp.where(masked, NEG_INF, s)
+    t_row = s.max(axis=-1)                                   # [T]
+    m_new = jnp.maximum(m, t_row)
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new[:, None])                          # [T, S]
+
+    # --- VJP proper (cotangents cm/cl/co of m_new/l_new/o_new) ---
+    # o_new = o*alpha + p_cast@v ; l_new = l*alpha + sum_j p
+    do_ref[0] = co * alpha[:, None]
+    dl_ref[0] = (cl * alpha)[:, None]
+    p_cast = p.astype(v.dtype)          # forward casts p to v's dtype
+    dv_ref[0] = dot(p_cast, co, ((0,), (0,))).astype(dv_ref.dtype)
+    dalpha = cl * l + (co * o).sum(axis=-1)                  # [T]
+    dp = dot(co, v.astype(jnp.float32), ((1,), (1,))) + cl[:, None]
+    # alpha = exp(m - m_new); p = exp(s - m_new)
+    dm_new = cm - dalpha * alpha - (dp * p).sum(axis=-1)
+    ds = dp * p                                              # [T, S]
+    # m_new = maximum(m, t_row): balanced tie split
+    sel_m = jnp.where(m > t_row, 1.0,
+                      jnp.where(m < t_row, 0.0, 0.5))
+    dm_ref[0] = (dalpha * alpha + dm_new * sel_m)[:, None]
+    # t_row = reduce_max(s): cotangent split equally among tied lanes
+    g_t = dm_new * (1.0 - sel_m)
+    eq = (s == t_row[:, None]).astype(jnp.float32)
+    ds = ds + (g_t / eq.sum(axis=-1))[:, None] * eq
+    if has_mask:
+        ds = jnp.where(masked, 0.0, ds)
+    ds = ds * sm_scale
+    # s_raw = q @ k^T (bf16 operands upcast exactly into the f32 dot)
+    dq_ref[0] = dot(ds, k.astype(jnp.float32),
+                    ((1,), (0,))).astype(dq_ref.dtype)
+    dk_ref[0] = dot(ds, q.astype(jnp.float32),
+                    ((0,), (0,))).astype(dk_ref.dtype)
+
+
+def _fused_bwd_enabled() -> bool:
+    """Backward selection for flash_block: the fused Pallas kernel by
+    default; ``RABIT_FLASH_BWD=recompute`` falls back to differentiating
+    the jnp twin through XLA (the pre-r4 behavior, kept as the parity
+    oracle)."""
+    import os
+    return os.environ.get("RABIT_FLASH_BWD", "fused") != "recompute"
+
+
+def flash_block_bwd(q, k, v, m, l, o, mask_i8, sm_scale, cm, cl, co):
+    """Fused backward pass: given the block inputs and output cotangents
+    (cm, cl, co), return (dq, dk, dv, dm, dl, do). Shapes mirror
+    ``flash_block``; mask_i8 is [T, S] int8 or None."""
+    from jax.experimental import pallas as pl
+
+    h, t, d = q.shape
+    s_len = k.shape[1]
+    has_mask = mask_i8 is not None
+    head = lambda i: (i, 0, 0)       # noqa: E731
+    whole = lambda i: (0, 0)         # noqa: E731
+    col = pl.BlockSpec((1, t, 1), head)
+    qd = pl.BlockSpec((1, t, d), head)
+    kd = pl.BlockSpec((1, s_len, d), head)
+    in_specs = [qd, kd, kd, col, col, qd]
+    ins = [q, k, v, m[..., None], l[..., None], o]
+    if has_mask:
+        in_specs.append(pl.BlockSpec((t, s_len), whole))
+        ins.append(mask_i8)
+    in_specs += [col, col, qd]
+    ins += [cm[..., None], cl[..., None], co]
+    dq, dk, dv, dm, dl, do = pl.pallas_call(
+        functools.partial(_flash_bwd_body, has_mask, sm_scale),
+        grid=(h,),
+        in_specs=in_specs,
+        out_specs=[qd, kd, kd, col, col, qd],
+        out_shape=[_out_struct((h, t, d), q.dtype, *ins),
+                   _out_struct((h, s_len, d), k.dtype, *ins),
+                   _out_struct((h, s_len, d), v.dtype, *ins),
+                   _out_struct((h, t, 1), jnp.float32, *ins),
+                   _out_struct((h, t, 1), jnp.float32, *ins),
+                   _out_struct((h, t, d), jnp.float32, *ins)],
+        interpret=_interpret(),
+    )(*ins)
+    return dq, dk, dv, dm[..., 0], dl[..., 0], do
+
+
 def flash_block(q, k, v, m, l, o, mask, sm_scale):
     """Pallas twin of ring_attention's ``_block_update``: same contract
     (q [H,T,D]; k/v [H,S,D]; m/l [H,T] f32; o [H,T,D] f32; mask [T,S]
     bool or None) and same return (m', l', o').
 
-    Differentiable via a recompute-based custom VJP: the forward runs
-    the MXU kernel; the backward re-derives the block update with the
-    mathematically identical jnp formulation (``_block_update``,
-    parity-tested against this kernel) and differentiates that — the
-    standard flash-attention trade of recompute for memory, with XLA
-    generating the backward. Inputs are cheap to save (the live K/V
-    block is already resident in the ring scan carry)."""
+    Differentiable via a recompute-based custom VJP. By default the
+    backward is the fused Pallas kernel (``_flash_bwd_body``): it
+    recomputes s/p from the saved inputs and applies the exact VJP of
+    the block update on the MXU, so the long-context training path's
+    backward throughput is the kernel's, not XLA's.
+    ``RABIT_FLASH_BWD=recompute`` reverts to differentiating the
+    mathematically identical jnp twin (``_block_update``) through XLA —
+    kept as the parity oracle the fused kernel is tested against.
+    Either way inputs are cheap to save (the live K/V block is already
+    resident in the ring scan carry)."""
     from jax.experimental import pallas as pl
 
     h, t, d = q.shape
@@ -301,9 +415,12 @@ def flash_block(q, k, v, m, l, o, mask, sm_scale):
 
         def bwd(res, ct):
             *prim, mask_i8 = res
+            mask_ct = np.zeros(mask_i8.shape, jax.dtypes.float0)
+            if _fused_bwd_enabled():
+                return (*flash_block_bwd(*prim, mask_i8, sm_scale, *ct),
+                        mask_ct)
             _, vjp = jax.vjp(
                 lambda *a: _jnp_twin(*a, mask_i8), *prim)
-            mask_ct = np.zeros(mask_i8.shape, jax.dtypes.float0)
             return (*vjp(ct), mask_ct)
     else:
         @jax.custom_vjp
@@ -314,6 +431,8 @@ def flash_block(q, k, v, m, l, o, mask, sm_scale):
             return run(*prim), prim
 
         def bwd(res, ct):
+            if _fused_bwd_enabled():
+                return flash_block_bwd(*res, None, sm_scale, *ct)
             _, vjp = jax.vjp(lambda *a: _jnp_twin(*a, None), *res)
             return vjp(ct)
 

@@ -1,0 +1,54 @@
+"""Dispatch-floor-cancelling slope timing for the tunnelled TPU.
+
+One dispatch+fetch through the tunnel costs ~65-80 ms regardless of
+payload, so per-call timing measures the tunnel, not the device. The
+methodology (shared by bench.py, tools/kernel_hw_proof.py and
+tools/histogram_sweep.py — it was drifting as three copies):
+
+- ``run_fn(k, salt)`` must run k work-iterations inside ONE jitted
+  dispatch (a ``lax.fori_loop`` cycling pre-staged device inputs);
+- the slope (T(k_big) - T(k_small)) / (k_big - k_small) cancels the
+  fixed dispatch+fetch cost;
+- ``salt`` must perturb an input every timing (fold it into the
+  accumulator init): the tunnel runtime memoizes
+  (executable, inputs) -> result, and a memo hit would "time" nothing;
+- best-of-``reps`` per point shields against RPC latency spikes;
+- a slope where the big batch is not measurably costlier than the small
+  one is noise — remeasure, and only a caller that explicitly opts in
+  (``allow_noisy``, CI smoke runs) gets a value instead of an error.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def slope_time(run_fn, k_small: int, k_big: int, *, salt_base: int = 100,
+               reps: int = 2, attempts: int = 3,
+               allow_noisy: bool = False) -> float:
+    """Seconds of true device time per work-iteration of ``run_fn``.
+
+    ``run_fn(k, salt)`` runs k iterations in one dispatch and returns
+    something numpy-coercible (coercion forces the fetch).
+    """
+    import numpy as np
+
+    def timed(k: int, salt: int) -> float:
+        np.asarray(run_fn(k, salt))          # compile + warm
+        best = float("inf")
+        for rep in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(run_fn(k, salt + 1 + rep))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    for attempt in range(attempts):
+        t_small = timed(k_small, salt_base + 100 * attempt)
+        t_big = timed(k_big, salt_base + 10 + 100 * attempt)
+        if t_big > t_small * 1.2:
+            return (t_big - t_small) / (k_big - k_small)
+    if allow_noisy:                           # CI smoke: quality moot
+        return max(t_big - t_small, 1e-9) / (k_big - k_small)
+    raise RuntimeError(
+        f"slope measurement unstable after {attempts} attempts "
+        f"(t{k_small}={t_small:.4f}s t{k_big}={t_big:.4f}s)")

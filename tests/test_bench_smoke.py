@@ -13,20 +13,26 @@ import sys
 from tests.test_integration import ROOT
 
 
-def test_bench_smoke_contract():
+def _hermetic_env(**overrides):
+    """CPU-pinned subprocess env with the image's axon sitecustomize dir
+    stripped from PYTHONPATH: its tunnel registration can hang
+    interpreter startup outright when the TPU relay is wedged, and the
+    smokes must pass hermetically."""
     env = dict(os.environ)
-    env.update({
-        "RABIT_BENCH_SMOKE": "1",
-        # the CPU backend is always reachable; don't wait on a probe
-        "RABIT_BENCH_PROBE_BUDGET_S": "5",
-        "JAX_PLATFORMS": "cpu",
-    })
-    # Drop the image's axon sitecustomize dir from PYTHONPATH: its
-    # tunnel registration can hang interpreter startup outright when
-    # the TPU relay is wedged, and the smoke must pass hermetically.
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(overrides)
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in env.get("PYTHONPATH", "").split(os.pathsep)
         if p and "axon" not in p) or ROOT
+    return env
+
+
+def test_bench_smoke_contract():
+    env = _hermetic_env(
+        RABIT_BENCH_SMOKE="1",
+        # the CPU backend is always reachable; don't wait on a probe
+        RABIT_BENCH_PROBE_BUDGET_S="5",
+    )
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py")],
         capture_output=True, timeout=600, env=env, cwd=ROOT)
@@ -52,15 +58,10 @@ def test_bench_degrades_to_cached_line_when_tunnel_down():
     emit one machine-parseable JSON line (cached newest BENCH_LOCAL_*
     values, flagged with status=tunnel_down) and exit 0 — never die
     mid-retry with nothing on stdout."""
-    env = dict(os.environ)
-    env.update({
-        "RABIT_BENCH_FAKE_TUNNEL_DOWN": "1",
-        "RABIT_BENCH_PROBE_BUDGET_S": "0",
-        "JAX_PLATFORMS": "cpu",
-    })
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
-        if p and "axon" not in p) or ROOT
+    env = _hermetic_env(
+        RABIT_BENCH_FAKE_TUNNEL_DOWN="1",
+        RABIT_BENCH_PROBE_BUDGET_S="0",
+    )
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "bench.py")],
         capture_output=True, timeout=120, env=env, cwd=ROOT)
@@ -75,3 +76,57 @@ def test_bench_degrades_to_cached_line_when_tunnel_down():
     # the repo carries committed artifacts, so the cached values are real
     assert res["value"] > 0
     assert res["cached_from"]
+
+
+def test_histogram_sweep_smoke_contract():
+    """tools/histogram_sweep.py (VERDICT r3 #4) must run its full path —
+    three kernel variants, slope timing, count-correctness check — on
+    the CPU backend in interpret mode, so the tool cannot be broken when
+    a tunnel window finally opens (the round-3 lesson: a measurement
+    tool that fails at capture time loses the round's evidence)."""
+    env = _hermetic_env(
+        RABIT_SWEEP_SMOKE="1",
+        RABIT_PALLAS_INTERPRET="1",
+    )
+    before = set(os.listdir(ROOT))
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "histogram_sweep.py")],
+        capture_output=True, timeout=900, env=env, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout.decode()[-2000:],
+                                 out.stderr.decode()[-2000:])
+    lines = out.stdout.decode().strip().splitlines()
+    assert "mask_only counts correct=True" in "\n".join(lines)
+    rows = [json.loads(ln) for ln in lines if ln.startswith("{")]
+    assert len(rows) == 2  # smoke grid: 1 row count x 2 nbins
+    for r in rows:
+        assert {"mask_only_ms", "fast_ms", "high_ms",
+                "per_component_ms"} <= set(r)
+    # smoke must not shed NEW artifacts into the repo (committed
+    # evidence artifacts from real runs are expected to exist)
+    fresh = set(os.listdir(ROOT)) - before
+    assert not [p for p in fresh if p.startswith("HIST_SWEEP")], fresh
+
+
+def test_kernel_hw_proof_smoke_contract():
+    """tools/kernel_hw_proof.py must run its full path — both histogram
+    branches, flash fwd+bwd parity, forward chain and fused-backward
+    chain slopes — on the CPU backend in interpret mode, so the capture
+    tool cannot be broken when a tunnel window opens."""
+    env = _hermetic_env(
+        RABIT_KERNEL_PROOF_SMOKE="1",
+        RABIT_PALLAS_INTERPRET="1",
+    )
+    before = set(os.listdir(ROOT))
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "kernel_hw_proof.py")],
+        capture_output=True, timeout=900, env=env, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout.decode()[-2000:],
+                                 out.stderr.decode()[-2000:])
+    text = out.stdout.decode()
+    assert "flash_block: fwd=True bwd=True" in text
+    assert "flash fwd+bwd chain" in text
+    assert text.strip().endswith("smoke ok")
+    # smoke must not shed NEW artifacts into the repo (committed
+    # evidence artifacts from real runs are expected to exist)
+    fresh = set(os.listdir(ROOT)) - before
+    assert not [p for p in fresh if p.startswith("KERNEL_HW")], fresh

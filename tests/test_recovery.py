@@ -166,3 +166,16 @@ def test_report_stats_smoke():
     assert run_cluster(2, "recover_worker.py",
                        extra_args=["rabit_engine=mock",
                                    "report_stats=1"]) == 0
+
+
+def test_shutdown_fence_serves_straggler():
+    """Reference AllreduceRobust::Shutdown two-phase exit
+    (allreduce_robust.cc:54-67): ranks that finish every iteration and
+    call finalize() must keep serving checkpoint loads and seq replays
+    at the shutdown fence until a respawned straggler catches up."""
+    assert run_cluster(4, "straggler_worker.py") == 0
+
+
+def test_shutdown_fence_straggler_is_tree_root():
+    # victim 0 is the tree root — the respawn reroutes every replay
+    assert run_cluster(4, "straggler_worker.py", env={"VICTIM": "0"}) == 0

@@ -163,3 +163,10 @@ def test_prepare_skipped_on_replay():
 def test_prepare_runs_fresh_without_failure():
     # the same worker healthy: both prepares run everywhere
     assert run_xla(3, "prepare_skip_worker.py") == 0
+
+
+def test_shutdown_fence_serves_straggler():
+    # shutdown fence with payload collectives on the device plane: the
+    # finishers' result logs hold device-produced tail results and must
+    # be replayed to the respawned straggler from inside finalize()
+    assert run_xla(4, "straggler_worker.py") == 0

@@ -134,13 +134,17 @@ def test_pallas_via_public_wrapper(mesh, monkeypatch):
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("flash_bwd", ["fused", "recompute"])
 @pytest.mark.parametrize("causal", [False, True])
-def test_pallas_grads_match_reference(mesh, monkeypatch, causal):
+def test_pallas_grads_match_reference(mesh, monkeypatch, causal,
+                                      flash_bwd):
     """Training through the Pallas flash path: gradients of the ring
-    attention with use_pallas=True (recompute-based custom VJP) match
-    the dense single-device oracle (VERDICT r2 #4 — previously
-    forward-only)."""
+    attention with use_pallas=True match the dense single-device oracle
+    (VERDICT r2 #4 — previously forward-only), with the backward running
+    BOTH as the fused Pallas kernel (the r4 default) and as the
+    XLA-differentiated recompute twin."""
     monkeypatch.setenv("RABIT_PALLAS_INTERPRET", "1")
+    monkeypatch.setenv("RABIT_FLASH_BWD", flash_bwd)
     q, k, v = _qkv(seed=12)
     sharding = NamedSharding(mesh, P("sp"))
     args = tuple(jax.device_put(x, sharding) for x in (q, k, v))
@@ -161,6 +165,49 @@ def test_pallas_grads_match_reference(mesh, monkeypatch, causal):
     for g, w in zip(got, want):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w),
                                    rtol=5e-4, atol=5e-4)
+
+
+@pytest.mark.parametrize("with_mask", [False, True])
+def test_fused_flash_backward_matches_twin(monkeypatch, with_mask):
+    """The fused Pallas backward kernel (VERDICT r3 #3) is the exact VJP
+    of the jnp block update: all six input gradients match
+    ``jax.vjp(_block_update)`` tightly, including the degenerate
+    first-step row (m == NEG_INF with a fully masked score row, where
+    jax's max-tie semantics split the cotangent)."""
+    monkeypatch.setenv("RABIT_PALLAS_INTERPRET", "1")
+    from rabit_tpu.ops.pallas_kernels import NEG_INF, flash_block_bwd
+    from rabit_tpu.parallel.ring_attention import _block_update
+
+    h, t, s, d = 2, 64, 64, 32
+    ks = jax.random.split(jax.random.PRNGKey(3), 10)
+    q = jax.random.normal(ks[0], (h, t, d), jnp.float32)
+    k = jax.random.normal(ks[1], (h, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (h, s, d), jnp.float32)
+    m = jax.random.normal(ks[3], (h, t), jnp.float32)
+    l = jax.random.uniform(ks[4], (h, t), jnp.float32) + 0.5
+    o = jax.random.normal(ks[5], (h, t, d), jnp.float32)
+    cm = jax.random.normal(ks[6], (h, t), jnp.float32)
+    cl = jax.random.normal(ks[7], (h, t), jnp.float32)
+    co = jax.random.normal(ks[8], (h, t, d), jnp.float32)
+    if with_mask:
+        mask = jax.random.uniform(ks[9], (t, s)) < 0.3
+        # row 0: fully masked scores AND a NEG_INF running max — the
+        # ring's first-step state, where both max ops tie exactly
+        mask = mask.at[0].set(True)
+        m = m.at[:, 0].set(NEG_INF)
+    else:
+        mask = None
+
+    sm_scale = float(d) ** -0.5
+    _, vjp = jax.vjp(
+        lambda *a: _block_update(*a, mask, sm_scale), q, k, v, m, l, o)
+    want = vjp((cm, cl, co))
+    got = flash_block_bwd(q, k, v, m, l, o,
+                          None if mask is None else mask.astype(jnp.int8),
+                          sm_scale, cm, cl, co)
+    for name, g, w in zip(("dq", "dk", "dv", "dm", "dl", "do"), got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-5, atol=1e-5, err_msg=name)
 
 
 def test_bad_impl_rejected(mesh):

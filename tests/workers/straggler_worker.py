@@ -1,0 +1,79 @@
+"""Shutdown-fence straggler scenario (reference AllreduceRobust::Shutdown
+two-phase consensus exit, allreduce_robust.cc:54-67).
+
+Every rank runs a checkpoint loop, then N_TAIL collectives AFTER the
+final checkpoint — their results exist only in the in-memory result log.
+The victim rank self-kills between its last collective and finalize(): the
+survivors reach finalize() with nothing left to compute, while the
+victim's respawn must reload the final checkpoint and replay every tail
+seq from the finishers' result logs. Without the shutdown fence the
+finishers drop their links immediately and strand the straggler; with it
+they loop at the pseudo-checkpoint fence serving the load + replays until
+the whole world reaches the fence.
+
+argv: key=value engine params (rabit_dataplane=... for the XLA plane)
+env:  N_ITER (default 3), N_TAIL (default 3), VICTIM (default 1),
+      RABIT_NUM_TRIAL (set by the tracker launcher: respawn attempt #)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+if os.environ.get("RABIT_DATAPLANE") == "xla":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init()
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+    n_iter = int(os.environ.get("N_ITER", "3"))
+    n_tail = int(os.environ.get("N_TAIL", "3"))
+    victim = int(os.environ.get("VICTIM", "1"))
+    attempt = int(os.environ.get("RABIT_NUM_TRIAL", "0"))
+
+    version, model = rabit.load_checkpoint()
+    if version == 0:
+        model = {"iter": 0}
+    assert model["iter"] == version, (model, version)
+
+    for it in range(model["iter"], n_iter):
+        s = rabit.allreduce(np.full(17, float(rank + 1 + it), np.float64),
+                            rabit.SUM)
+        np.testing.assert_allclose(
+            s, np.full(17, world * (world + 1) / 2 + world * it),
+            err_msg=f"SUM wrong at iter {it}")
+        model["iter"] = it + 1
+        rabit.checkpoint(model)
+
+    # Tail collectives past the last checkpoint: on a respawn these seqs
+    # can only be satisfied by replay from ranks already in finalize().
+    for s in range(n_tail):
+        out = rabit.allreduce(
+            np.full(31, float((rank + 1) * (s + 1)), np.float64), rabit.SUM)
+        np.testing.assert_allclose(
+            out, np.full(31, world * (world + 1) / 2 * (s + 1)),
+            err_msg=f"tail SUM wrong at seq {s} (attempt {attempt})")
+
+    if rank == victim and attempt == 0:
+        # all collectives done, finalize not yet called: the other ranks
+        # have nothing left to compute and head straight into shutdown
+        print(f"straggler_worker rank {rank} self-kill pre-finalize",
+              file=sys.stderr, flush=True)
+        os._exit(255)
+
+    rabit.tracker_print(
+        f"straggler_worker rank {rank}/{world} attempt {attempt} done")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

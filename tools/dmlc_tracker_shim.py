@@ -37,6 +37,7 @@ import struct
 import subprocess
 import sys
 import threading
+import time
 
 MAGIC = 0xff99
 
@@ -178,9 +179,24 @@ def main() -> int:
     for i in range(args.n):
         env = dict(os.environ, DMLC_TASK_ID=str(i), **tr.env())
         procs.append(subprocess.Popen(args.cmd, env=env))
+    # Poll instead of serially waiting: if one reference worker crashes
+    # (rather than erroring through the protocol), the survivors block
+    # forever in their collectives and a blind p.wait() would hang the
+    # whole grid run until the harness timeout. On the first nonzero
+    # exit, reap the rest.
     rc = 0
-    for p in procs:
-        rc |= p.wait()
+    done: set = set()
+    while len(done) < len(procs):
+        for i, p in enumerate(procs):
+            if i in done or p.poll() is None:
+                continue
+            done.add(i)
+            rc |= p.returncode
+            if p.returncode != 0:
+                for j, q in enumerate(procs):
+                    if j not in done and q.poll() is None:
+                        q.terminate()
+        time.sleep(0.2)
     return rc
 
 

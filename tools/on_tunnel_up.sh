@@ -1,0 +1,29 @@
+#!/bin/bash
+# Measurement suite to run the moment the TPU tunnel is reachable.
+# Invoked by the background tunnel watcher (tools/tunnel_watch.sh); safe
+# to run by hand. Each step is independently timeout-guarded so one
+# wedged dispatch cannot starve the rest if the tunnel drops mid-suite.
+set -u
+cd /root/repo
+TS=$(date -u +%Y%m%dT%H%M%SZ)
+LOG=/tmp/on_tunnel_up_$TS.log
+echo "=== tunnel-up suite $TS ===" | tee -a "$LOG"
+
+# Full bench: generous budgets (this is the manual/live path, not the
+# driver's capped one).
+RABIT_BENCH_DEADLINE_S=1700 RABIT_BENCH_PROBE_BUDGET_S=120 \
+  timeout 1800 python bench.py >>"$LOG" 2>&1
+echo "bench rc=$?" | tee -a "$LOG"
+
+# Kernel HW proof (fusion branches + flash fwd/bwd throughput).
+timeout 1800 python tools/kernel_hw_proof.py >>"$LOG" 2>&1
+echo "kernel_hw_proof rc=$?" | tee -a "$LOG"
+
+# Histogram cost sweep (VERDICT r3 #4), if present.
+if [ -f tools/histogram_sweep.py ]; then
+  timeout 1800 python tools/histogram_sweep.py >>"$LOG" 2>&1
+  echo "histogram_sweep rc=$?" | tee -a "$LOG"
+fi
+
+echo "=== suite done; artifacts: ===" | tee -a "$LOG"
+ls -t BENCH_LOCAL_*.json KERNEL_HW_*.json HIST_SWEEP_*.json 2>/dev/null | head -6 | tee -a "$LOG"

@@ -168,8 +168,15 @@ def main() -> None:
                        "bcast_ours": ndata * 4,
                        "bcast_reference": ndata},
                    "ours_MBps": ours, "reference_MBps": ref,
+                   # bcast is EXCLUDED from the per-row speedup dict: at
+                   # equal ndata the payloads differ 4x (ours moves
+                   # ndata*4 bytes of f32, the reference ndata*1 of
+                   # char), so a same-row rate ratio is not
+                   # apples-to-apples. Compare bcast across rows at
+                   # equal bytes (e.g. our ndata=1M vs reference
+                   # ndata=4M) — see PERF.md's equal-byte table.
                    "speedup": {k: round(ours[k] / ref[k], 2)
-                               for k in ours}}
+                               for k in ours if k != "bcast"}}
             rows.append(row)
             print(json.dumps(row), flush=True)
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(

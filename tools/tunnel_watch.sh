@@ -1,0 +1,22 @@
+#!/bin/bash
+# Background tunnel watcher: probe the TPU tunnel in throwaway processes
+# (a wedged tunnel hangs any dispatch, so never probe in a process you
+# need); the moment a probe succeeds, run tools/on_tunnel_up.sh once and
+# exit. Log: /tmp/tunnel_watch.log
+LOG=/tmp/tunnel_watch.log
+echo "watcher start $(date -u +%H:%M:%S)" >>"$LOG"
+while true; do
+  timeout 100 python -c "
+import time, jax.numpy as jnp, numpy as np
+np.asarray((jnp.ones((8,)) * float(time.time() % 1e4)).sum())
+print('UP')
+" >>"$LOG" 2>&1
+  if [ $? -eq 0 ]; then
+    echo "tunnel UP at $(date -u +%H:%M:%S); running suite" >>"$LOG"
+    bash /root/repo/tools/on_tunnel_up.sh >>"$LOG" 2>&1
+    echo "suite finished rc=$? at $(date -u +%H:%M:%S)" >>"$LOG"
+    exit 0
+  fi
+  echo "probe failed $(date -u +%H:%M:%S); sleeping 300s" >>"$LOG"
+  sleep 300
+done
