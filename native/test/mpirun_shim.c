@@ -1,0 +1,85 @@
+/* mpirun reconstructed from libopen-rte, like orted_shim.c (the Debian
+   runtime package ships the library but no launcher binaries).
+
+   OpenMPI 4.1's real mpirun main() delegates to orterun(), whose whole
+   machinery is EXPORTED from libopen-rte: orte_submit_init parses the
+   mpirun command line and brings up the HNP, orte_submit_job launches
+   the app procs and fires launch/complete callbacks, and the caller
+   spins the opal event base meanwhile (Debian links the system
+   libevent, so the loop is plain event_base_loop). One non-obvious
+   piece recovered from the upstream 4.1.x orterun.c: the HNP must
+   register orte_daemon_recv on the daemon-command RML tag itself —
+   the app-launch xcast lands there, and without the listener the local
+   procs are never forked. */
+#include <stdbool.h>
+#include <stddef.h>
+#include <stdint.h>
+
+struct event_base;
+extern struct event_base *orte_event_base;
+extern volatile unsigned char orte_event_base_active; /* opal bool */
+extern int orte_exit_status;
+
+/* orte_process_name_t: {jobid u32, vpid u32} */
+typedef struct { uint32_t jobid; uint32_t vpid; } orte_process_name_t;
+extern orte_process_name_t orte_name_wildcard;
+
+#define ORTE_RML_TAG_DAEMON 1
+#define ORTE_RML_PERSISTENT true
+
+typedef void (*orte_submit_cbfunc_t)(int index, void *jdata, int ret,
+                                     void *cbdata);
+
+extern int orte_submit_init(int argc, char *argv[], void *opts);
+extern int orte_submit_job(char *cmd[], int *index,
+                           orte_submit_cbfunc_t launch_cb,
+                           void *launch_cbdata,
+                           orte_submit_cbfunc_t complete_cb,
+                           void *complete_cbdata);
+extern int orte_submit_finalize(void);
+extern int orte_finalize(void);
+extern void orte_rml_API_recv_buffer_nb(orte_process_name_t *peer,
+                                        uint32_t tag, bool persistent,
+                                        void (*cb)(void), void *cbdata);
+extern void orte_daemon_recv(void);
+extern int event_base_loop(struct event_base *, int);
+#define EVLOOP_ONCE 0x01
+
+static volatile bool launch_active = true;
+static volatile bool complete_active = true;
+
+static void launched(int index, void *jdata, int ret, void *cbdata)
+{
+    (void)index; (void)jdata; (void)cbdata;
+    if (ret != 0)
+        orte_exit_status = ret;
+    launch_active = false;
+}
+
+static void completed(int index, void *jdata, int ret, void *cbdata)
+{
+    (void)index; (void)jdata; (void)ret; (void)cbdata;
+    complete_active = false;
+}
+
+int main(int argc, char *argv[])
+{
+    int idx = 0;
+    int rc = orte_submit_init(argc, argv, NULL);
+    if (rc != 0)
+        return 1;
+    /* listen for daemon commands sent to the HNP itself (see header) */
+    orte_rml_API_recv_buffer_nb(&orte_name_wildcard, ORTE_RML_TAG_DAEMON,
+                                ORTE_RML_PERSISTENT,
+                                (void (*)(void))orte_daemon_recv, NULL);
+    rc = orte_submit_job(argv, &idx, launched, NULL, completed, NULL);
+    if (rc != 0)
+        return 1;
+    while (orte_event_base_active && launch_active)
+        event_base_loop(orte_event_base, EVLOOP_ONCE);
+    while (orte_event_base_active && complete_active)
+        event_base_loop(orte_event_base, EVLOOP_ONCE);
+    orte_submit_finalize();
+    orte_finalize();
+    return orte_exit_status;
+}

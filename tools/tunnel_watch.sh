@@ -7,7 +7,8 @@ LOG=/tmp/tunnel_watch.log
 echo "watcher start $(date -u +%H:%M:%S)" >>"$LOG"
 while true; do
   timeout 100 python -c "
-import time, jax.numpy as jnp, numpy as np
+import time, jax, jax.numpy as jnp, numpy as np
+assert jax.default_backend() == 'tpu', jax.default_backend()
 np.asarray((jnp.ones((8,)) * float(time.time() % 1e4)).sum())
 print('UP')
 " >>"$LOG" 2>&1
