@@ -37,3 +37,22 @@ def test_our_speed_test_parses():
     ours = svr.run_ours(world=2, ndata=100_000, nrep=2)
     assert set(ours) == {"sum", "max", "bcast"}
     assert all(v > 0 for v in ours.values())
+
+
+def test_reference_recovery_under_shim():
+    """VERDICT r3 #6: the reference's UNMODIFIED recovery programs
+    (mock engine, scripted kills, exit-255 respawns with an advanced
+    attempt counter) pass under our tracker shim — protocol-fidelity
+    proof for start/recover link repair and rank stability across
+    restarts. CI runs the quick subset; the committed REF_RECOVER_*
+    artifact carries the full test.mk grid at world 10."""
+    import subprocess
+    import sys
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools",
+                                      "reference_recovery.py"), "--quick"],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
+    assert '"rc": 0' in out.stdout
+    # kills actually happened and were respawned (not a no-failure run)
+    assert '"respawns": 0' not in out.stdout

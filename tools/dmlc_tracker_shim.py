@@ -1,7 +1,9 @@
 #!/usr/bin/env python
 """Minimal dmlc-tracker-protocol server: launches the REFERENCE rabit
 binaries (built out-of-tree from /root/reference) so their speed_test
-can run head-to-head against ours on the same host.
+can run head-to-head against ours on the same host, and their recovery
+programs (model_recover etc.) can run under scripted kills + respawns
+(--max-attempts, the dmlc-submit --local-num-attempt role).
 
 The reference's worker-side protocol (observed at
 /root/reference/src/allreduce_base.cc:222-441; the real server lives in
@@ -70,8 +72,14 @@ def _send_str(conn, s: str) -> None:
 
 
 class RefTracker:
-    """Serves one generation of `n` reference workers (no restarts —
-    this shim exists for the speed benchmark, not recovery tests)."""
+    """Serves `n` reference workers, including restarts: both "start"
+    and "recover" go through the dmlc wait_conn link-repair algorithm
+    (dmlc-core tracker semantics, reconstructed from the worker side at
+    /root/reference/src/allreduce_base.cc:264-441): each session reports
+    its good links; the tracker tells it to DIAL every broken peer that
+    is already parked listening (wait_conn) and to ACCEPT the rest; on
+    completion it is parked itself if links remain. Rank is stable
+    across restarts via the task_id -> rank map."""
 
     def __init__(self, nworkers: int):
         self.n = nworkers
@@ -81,7 +89,10 @@ class RefTracker:
         self.sock.listen(nworkers + 8)
         self.port = self.sock.getsockname()[1]
         self.ports = {}          # rank -> listen port
-        self.shutdown_seen = 0
+        self.job_map = {}        # task_id -> rank (stable on respawn)
+        self.wait_conn = {}      # rank -> [port, pending_accept_count]
+        self.next_rank = 0
+        self.done_ranks = set()  # ranks whose final process shut down
         self.thread = threading.Thread(target=self._serve, daemon=True)
 
     def env(self) -> dict:
@@ -95,9 +106,19 @@ class RefTracker:
         kids = [c for c in (2 * r + 1, 2 * r + 2) if c < self.n]
         return parent, ([parent] if r else []) + kids
 
-    def _serve_start(self, conn, rank_counter):
-        rank = rank_counter[0]
-        rank_counter[0] += 1
+    def _assign_rank(self, conn, sent_rank: int, task_id: str):
+        if sent_rank >= 0:
+            rank = sent_rank               # "recover": keeps its rank
+        elif task_id in self.job_map:
+            rank = self.job_map[task_id]   # respawn of a known task
+        else:
+            rank = self.next_rank
+            self.next_rank += 1
+        self.job_map[task_id] = rank
+        # a rank re-entering the tracker has no live listener yet; drop
+        # any stale parked entry so nobody is told to dial a dead port
+        self.wait_conn.pop(rank, None)
+
         parent, neigh = self._neighbors(rank)
         prev_r = (rank - 1) % self.n if self.n > 1 else -1
         next_r = (rank + 1) % self.n if self.n > 1 else -1
@@ -109,61 +130,88 @@ class RefTracker:
             _send_int(conn, nr)
         _send_int(conn, prev_r)
         _send_int(conn, next_r)
-        # ranks this worker must dial: every already-served peer it
-        # shares a tree or ring edge with
         linked = set(neigh) | {prev_r, next_r}
         linked.discard(-1)
-        to_conn = sorted(x for x in linked if x < rank)
-        num_accept = len([x for x in linked if x > rank])
+        linked.discard(rank)
+
+        def credit(pr):
+            # one pending accept of a parked peer has been consumed
+            entry = self.wait_conn.get(pr)
+            if entry is not None:
+                entry[1] -= 1
+                if entry[1] <= 0:
+                    self.wait_conn.pop(pr, None)
+
+        offered: list = []
         while True:
             good = {_recv_int(conn) for _ in range(_recv_int(conn))}
-            # only the not-yet-established links: re-sending an already
+            bad = sorted(linked - good)
+            # Reconcile the PREVIOUS round's offers now that `good`
+            # reports their outcome: a dial that succeeded consumed one
+            # of the parked peer's pending accepts; a dial that FAILED
+            # means the parked entry's port is stale (its worker died —
+            # on loopback, connects to live listeners don't fail), so
+            # evict it: this session then accepts that edge instead and
+            # the peer's respawn dials us, and — critically — the
+            # single-threaded serve loop gets free to serve that
+            # respawn instead of re-offering a dead port forever.
+            for pr in offered:
+                if pr in good:
+                    credit(pr)
+                else:
+                    self.wait_conn.pop(pr, None)
+            # dial peers already parked listening; accept from the rest
+            # (they will be told to dial us once we park). Only the
+            # not-yet-established links ride each round: re-sending a
             # good peer trips the worker's "Override a link that is
-            # active" assert (allreduce_base.cc:376) on retry rounds
-            pending = [r for r in to_conn if r not in good]
-            _send_int(conn, len(pending))
-            _send_int(conn, num_accept)
-            for pr in pending:
+            # active" assert (allreduce_base.cc:376) on retry rounds.
+            offered = [r for r in bad if r in self.wait_conn]
+            _send_int(conn, len(offered))
+            _send_int(conn, len(bad) - len(offered))
+            for pr in offered:
                 _send_str(conn, "127.0.0.1")
-                _send_int(conn, self.ports[pr])
+                _send_int(conn, self.wait_conn[pr][0])
                 _send_int(conn, pr)
             if _recv_int(conn) == 0:      # num_error
                 break
         self.ports[rank] = _recv_int(conn)
+        for pr in offered:                # final round: all succeeded
+            credit(pr)
+        n_accept = len(bad) - len(offered)
+        if n_accept > 0:
+            self.wait_conn[rank] = [self.ports[rank], n_accept]
 
     def _serve(self):
-        # Loud failure: a protocol surprise (e.g. a crashed worker
-        # reconnecting with cmd "recover", which this benchmark shim
-        # does not support) must not strand the remaining workers in
-        # blocking tracker I/O with a silently dead daemon thread.
+        # Loud failure: a protocol surprise must not strand the
+        # remaining workers in blocking tracker I/O with a silently
+        # dead daemon thread.
         try:
             self._serve_loop()
         except BaseException:
             import traceback
             traceback.print_exc()
-            print("[ref-tracker] fatal: aborting benchmark run",
+            print("[ref-tracker] fatal: aborting run",
                   file=sys.stderr, flush=True)
             os._exit(2)
 
     def _serve_loop(self):
-        rank_counter = [0]
-        while self.shutdown_seen < self.n:
+        while len(self.done_ranks) < self.n:
             conn, _ = self.sock.accept()
             magic = _recv_int(conn)
             assert magic == MAGIC, f"bad magic {magic:#x}"
             _send_int(conn, MAGIC)
-            _recv_int(conn)               # advertised rank
+            sent_rank = _recv_int(conn)   # -1 on fresh start
             _recv_int(conn)               # advertised world
-            _recv_str(conn)               # task id
+            task_id = _recv_str(conn)
             cmd = _recv_str(conn)
-            if cmd == "start":
-                self._serve_start(conn, rank_counter)
+            if cmd in ("start", "recover"):
+                self._assign_rank(conn, sent_rank, task_id)
             elif cmd == "print":
                 print(f"[ref-tracker] {_recv_str(conn)}", end="",
                       flush=True)
             elif cmd == "shutdown":
-                self.shutdown_seen += 1
-            else:                         # recover unsupported here
+                self.done_ranks.add(self.job_map.get(task_id, sent_rank))
+            else:
                 raise RuntimeError(f"shim got cmd {cmd!r}")
             conn.close()
 
@@ -171,29 +219,46 @@ class RefTracker:
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", type=int, required=True)
+    ap.add_argument("--max-attempts", type=int, default=0,
+                    help="respawns per worker on exit 255 (the mock "
+                         "engine's scripted-kill exit); 0 = benchmark "
+                         "mode, any death aborts the run")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args()
     tr = RefTracker(args.n)
     tr.thread.start()
-    procs = []
-    for i in range(args.n):
-        env = dict(os.environ, DMLC_TASK_ID=str(i), **tr.env())
-        procs.append(subprocess.Popen(args.cmd, env=env))
+
+    attempts = {i: 0 for i in range(args.n)}
+
+    def spawn(i: int) -> subprocess.Popen:
+        env = dict(os.environ, DMLC_TASK_ID=str(i),
+                   DMLC_NUM_ATTEMPT=str(attempts[i]), **tr.env())
+        return subprocess.Popen(args.cmd, env=env)
+
+    procs = {i: spawn(i) for i in range(args.n)}
     # Poll instead of serially waiting: if one reference worker crashes
-    # (rather than erroring through the protocol), the survivors block
-    # forever in their collectives and a blind p.wait() would hang the
-    # whole grid run until the harness timeout. On the first nonzero
-    # exit, reap the rest.
+    # for real, the survivors block forever in their collectives and a
+    # blind p.wait() would hang the whole run until the harness timeout.
+    # Exit 255 (utils::Error / the mock's scripted kill) respawns with
+    # an advanced attempt counter, like dmlc-submit --local-num-attempt.
     rc = 0
     done: set = set()
-    while len(done) < len(procs):
-        for i, p in enumerate(procs):
+    while len(done) < args.n:
+        for i, p in list(procs.items()):
             if i in done or p.poll() is None:
                 continue
+            if (p.returncode in (255, -6) and
+                    attempts[i] < args.max_attempts):
+                attempts[i] += 1
+                print(f"[ref-launcher] worker {i} died "
+                      f"rc={p.returncode}; respawn attempt "
+                      f"{attempts[i]}", file=sys.stderr, flush=True)
+                procs[i] = spawn(i)
+                continue
             done.add(i)
-            rc |= p.returncode
+            rc |= p.returncode & 0xff
             if p.returncode != 0:
-                for j, q in enumerate(procs):
+                for j, q in procs.items():
                     if j not in done and q.poll() is None:
                         q.terminate()
         time.sleep(0.2)

@@ -43,14 +43,34 @@ DMLC_IO_STUB = """\
 #ifndef DMLC_IO_H_
 #define DMLC_IO_H_
 #include <cstddef>
+#include <cstdint>
 #include <cstring>
 #include <string>
+#include <vector>
 namespace dmlc {
 class Stream {
  public:
   virtual size_t Read(void* ptr, size_t size) = 0;
   virtual void Write(const void* ptr, size_t size) = 0;
   virtual ~Stream() {}
+  // templated POD-vector helpers (subset of dmlc-core's serializer,
+  // used by the reference test models' Load/Save); wire format only
+  // needs to round-trip through rabit's in-memory checkpoints
+  template<typename T>
+  inline void Write(const std::vector<T>& v) {
+    uint64_t sz = v.size();
+    Write(&sz, sizeof(sz));
+    if (sz) Write(v.data(), sz * sizeof(T));
+  }
+  template<typename T>
+  inline bool Read(std::vector<T>* v) {
+    uint64_t sz;
+    if (Read(&sz, sizeof(sz)) != sizeof(sz)) return false;
+    v->resize(sz);
+    if (sz && Read(v->data(), sz * sizeof(T)) != sz * sizeof(T))
+      return false;
+    return true;
+  }
 };
 class SeekStream : public Stream {
  public:
@@ -75,9 +95,11 @@ DMLC_BASE_STUB = """\
 """
 
 
-def build_reference(workdir: str) -> str:
-    """Compile the reference's socket engine + speed_test out-of-tree.
-    Returns the binary path."""
+def build_reference(workdir: str, test_src: str = "speed_test",
+                    mock: bool = False) -> str:
+    """Compile a reference test program + its socket engine out-of-tree
+    (``mock=True`` links engine_mock.cc — the failure-injection engine
+    the recovery programs need). Returns the binary path."""
     os.makedirs(os.path.join(workdir, "dmlc"), exist_ok=True)
     os.makedirs(os.path.join(workdir, "include", "dmlc"), exist_ok=True)
     os.makedirs(os.path.join(workdir, "x"), exist_ok=True)
@@ -95,16 +117,18 @@ def build_reference(workdir: str) -> str:
                 f"reference build failed: {' '.join(cmd)}\n"
                 f"{out.stderr[-4000:]}")
 
+    engine = "engine_mock" if mock else "engine"
     objs = []
-    for src in ("allreduce_base", "allreduce_robust", "engine"):
+    for src in ("allreduce_base", "allreduce_robust", engine):
         obj = os.path.join(workdir, f"{src}.o")
-        cc(["g++", "-c", "-O3", "-std=c++11",
-            f"-I{REF}/include", f"-I{workdir}", f"-I{workdir}/x",
-            f"{REF}/src/{src}.cc", "-o", obj])
+        if not os.path.exists(obj):  # shared across programs in one dir
+            cc(["g++", "-c", "-O3", "-std=c++11",
+                f"-I{REF}/include", f"-I{workdir}", f"-I{workdir}/x",
+                f"{REF}/src/{src}.cc", "-o", obj])
         objs.append(obj)
-    binary = os.path.join(workdir, "ref_speed_test")
+    binary = os.path.join(workdir, f"ref_{test_src}")
     cc(["g++", "-O3", "-std=c++11", f"-I{REF}/include", f"-I{workdir}",
-        f"{REF}/test/speed_test.cc", *objs, "-o", binary,
+        f"{REF}/test/{test_src}.cc", *objs, "-o", binary,
         "-pthread", "-lm"])
     return binary
 
