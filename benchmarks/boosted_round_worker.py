@@ -1,0 +1,91 @@
+"""Benchmark worker: distributed gradient-boosting rounds at benchmark
+size (the reference's motivating workload, doc/guide.md:137-143 — what
+examples/py/boosted_trees.py demonstrates at toy size).
+
+Each round, per worker: compute g/h over the shard, build the flattened
+(feature, bucket) gradient histogram ((rows x F) contributions via
+per-worker bincount — the host-side build the reference's workers do),
+then ``rabit.allreduce`` the [nbins, 2] histogram. Per-phase wall times
+are measured per round; the cluster-wide MAX per phase rides a final
+allreduce, and rank 0 prints ONE JSON line with the per-round means
+(first round excluded as warmup).
+
+env: ROWS (default 131072), N_FEAT (16), N_BUCKETS (64), N_ROUNDS (10)
+Launch:  python -m rabit_tpu.tracker.launch -n 8 \\
+             python benchmarks/boosted_round_worker.py
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init()
+    rank, world = rabit.get_rank(), rabit.get_world_size()
+    rows = int(os.environ.get("ROWS", str(1 << 17)))
+    n_feat = int(os.environ.get("N_FEAT", "16"))
+    n_buckets = int(os.environ.get("N_BUCKETS", "64"))
+    n_rounds = int(os.environ.get("N_ROUNDS", "10"))
+    nbins = n_feat * n_buckets
+
+    rng = np.random.default_rng(100 + rank)
+    x = rng.random((rows, n_feat), dtype=np.float32)
+    y = (rng.random(rows) < 0.5).astype(np.float64)
+    buckets = np.minimum((x * n_buckets).astype(np.int64), n_buckets - 1)
+    # flattened (feature, bucket) ids: each row contributes to EVERY
+    # feature's histogram — rows x F contributions per round
+    flat = (buckets + np.arange(n_feat)[None, :] * n_buckets).ravel()
+
+    margin = np.zeros(rows, np.float64)
+    t_hist, t_coll = [], []
+    for rnd in range(n_rounds):
+        p = 1.0 / (1.0 + np.exp(-margin))
+        g, h = p - y, p * (1.0 - p)
+
+        t0 = time.perf_counter()
+        gw = np.repeat(g, n_feat)
+        hw = np.repeat(h, n_feat)
+        hist = np.stack([
+            np.bincount(flat, weights=gw, minlength=nbins),
+            np.bincount(flat, weights=hw, minlength=nbins)], axis=1)
+        t1 = time.perf_counter()
+        hist = rabit.allreduce(hist.ravel(), rabit.SUM)
+        t2 = time.perf_counter()
+        t_hist.append(t1 - t0)
+        t_coll.append(t2 - t1)
+
+        # a split-like consumer keeps the loop honest (and the margin
+        # moving so g/h change every round)
+        hist = hist.reshape(nbins, 2)
+        b = int(np.argmax(hist[:, 0] ** 2 / (hist[:, 1] + 1.0)))
+        f, bk = divmod(b, n_buckets)
+        margin += 0.3 * np.where(buckets[:, f] <= bk, -0.1, 0.1)
+
+    # cluster-wide per-phase MAX (the round completes when the slowest
+    # worker does), then per-round means excluding the warmup round
+    per_round = np.stack([t_hist, t_coll])          # [2, n_rounds]
+    per_round = rabit.allreduce(per_round, rabit.MAX)
+    if rank == 0:
+        hist_ms = float(per_round[0, 1:].mean() * 1e3)
+        coll_ms = float(per_round[1, 1:].mean() * 1e3)
+        print(json.dumps({
+            "world": world, "rows_per_worker": rows, "n_feat": n_feat,
+            "n_buckets": n_buckets, "nbins": nbins,
+            "contributions_per_worker": rows * n_feat,
+            "rounds_timed": n_rounds - 1,
+            "host_hist_ms_per_round": round(hist_ms, 3),
+            "allreduce_ms_per_round": round(coll_ms, 3),
+            "host_round_ms": round(hist_ms + coll_ms, 3)}), flush=True)
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -130,3 +130,27 @@ def test_kernel_hw_proof_smoke_contract():
     # evidence artifacts from real runs are expected to exist)
     fresh = set(os.listdir(ROOT)) - before
     assert not [p for p in fresh if p.startswith("KERNEL_HW")], fresh
+
+
+def test_boosted_bench_smoke_contract():
+    """tools/boosted_bench.py (VERDICT r3 #7) must run both phases —
+    8 tracker-launched boosting workers and the kernel-build slope —
+    end to end at smoke sizes, so the capture tool cannot be broken
+    when a tunnel window opens."""
+    env = _hermetic_env(RABIT_BOOSTED_SMOKE="1")
+    before = set(os.listdir(ROOT))
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "boosted_bench.py")],
+        capture_output=True, timeout=900, env=env, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout.decode()[-2000:],
+                                 out.stderr.decode()[-2000:])
+    lines = [ln for ln in out.stdout.decode().splitlines()
+             if ln.startswith("{")]
+    phases = {json.loads(ln)["phase"] for ln in lines}
+    assert phases == {"host_8_workers", "tpu_kernel"}
+    host = next(json.loads(ln) for ln in lines
+                if json.loads(ln)["phase"] == "host_8_workers")
+    assert host["world"] == 8
+    assert host["host_round_ms"] > 0
+    fresh = set(os.listdir(ROOT)) - before
+    assert not [p for p in fresh if p.startswith("BOOSTED_BENCH")], fresh

@@ -46,13 +46,26 @@ def test_reference_recovery_under_shim():
     proof for start/recover link repair and rank stability across
     restarts. CI runs the quick subset; the committed REF_RECOVER_*
     artifact carries the full test.mk grid at world 10."""
+    import json
     import subprocess
     import sys
+    env = dict(os.environ)
+    # hermetic: the axon sitecustomize can hang interpreter startup
+    # when the TPU relay is wedged (see tests/test_bench_smoke.py)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p) or ROOT
     out = subprocess.run(
         [sys.executable, os.path.join(ROOT, "tools",
                                       "reference_recovery.py"), "--quick"],
-        capture_output=True, text=True, timeout=600)
+        capture_output=True, text=True, timeout=600, env=env)
     assert out.returncode == 0, (out.stdout[-2000:], out.stderr[-2000:])
-    assert '"rc": 0' in out.stdout
-    # kills actually happened and were respawned (not a no-failure run)
-    assert '"respawns": 0' not in out.stdout
+    rows = [json.loads(ln) for ln in out.stdout.splitlines()
+            if ln.startswith("{")]
+    assert len(rows) == 2
+    for r in rows:
+        assert r["rc"] == 0, r
+        # the runner enforces the DETERMINISTIC kill count per scenario
+        # (reference asserts also exit 255, so inflated respawn counts
+        # would mask shim protocol bugs)
+        assert r["respawns"] == r["expected_respawns"] > 0, r

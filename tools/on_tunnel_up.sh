@@ -25,5 +25,13 @@ if [ -f tools/histogram_sweep.py ]; then
   echo "histogram_sweep rc=$?" | tee -a "$LOG"
 fi
 
+# End-to-end boosting-round bench (VERDICT r3 #7): host phase + the
+# TPU kernel phase that needs the tunnel.
+if [ -f tools/boosted_bench.py ]; then
+  timeout 1800 python tools/boosted_bench.py >>"$LOG" 2>&1
+  echo "boosted_bench rc=$?" | tee -a "$LOG"
+fi
+
 echo "=== suite done; artifacts: ===" | tee -a "$LOG"
-ls -t BENCH_LOCAL_*.json KERNEL_HW_*.json HIST_SWEEP_*.json 2>/dev/null | head -6 | tee -a "$LOG"
+ls -t BENCH_LOCAL_*.json KERNEL_HW_*.json HIST_SWEEP_*.json \
+  BOOSTED_BENCH_*.json 2>/dev/null | head -8 | tee -a "$LOG"

@@ -30,37 +30,43 @@ sys.path.insert(0, os.path.join(REPO, "tools"))
 
 from socket_vs_reference import build_reference  # noqa: E402
 
-# (name, program, nworkers, args) — transcribed from the reference's
-# test.mk targets (rabit_debug dropped: it only adds stderr volume)
+# (name, program, nworkers, expect_respawns, args) — transcribed from
+# the reference's test.mk targets (rabit_debug dropped: it only adds
+# stderr volume). expect_respawns is the DETERMINISTIC number of
+# scripted kills that actually fire (a kill at trial 0 advances that
+# rank's attempt counter, so a later same-rank trial-0 entry never
+# fires — e.g. die_same's mock=0,1,1,0 after mock=0,0,1,0). Enforcing
+# the exact count matters: the reference's asserts also exit(255), so
+# without it a shim protocol bug could retry itself into a pass.
 SCENARIOS = [
-    ("model_recover_10_10k", "model_recover", 10,
+    ("model_recover_10_10k", "model_recover", 10, 2,
      ["10000", "mock=0,0,1,0", "mock=1,1,1,0", "rabit_bootstrap_cache=-1",
       "rabit_reduce_ring_mincount=1"]),
-    ("model_recover_10_10k_die_same", "model_recover", 10,
+    ("model_recover_10_10k_die_same", "model_recover", 10, 4,
      ["10000", "mock=0,0,1,0", "mock=1,1,1,0", "mock=0,1,1,0",
       "mock=4,1,1,0", "mock=9,1,1,0", "rabit_bootstrap_cache=1"]),
-    ("model_recover_10_10k_die_hard", "model_recover", 10,
+    ("model_recover_10_10k_die_hard", "model_recover", 10, 6,
      ["10000", "mock=0,0,1,0", "mock=1,1,1,0", "mock=1,1,1,1",
       "mock=0,1,1,0", "mock=4,1,1,0", "mock=9,1,1,0", "mock=8,1,2,0",
       "mock=4,1,3,0", "rabit_bootstrap_cache=1"]),
-    ("local_recover_10_10k", "local_recover", 10,
+    ("local_recover_10_10k", "local_recover", 10, 5,
      ["10000", "mock=0,0,1,0", "mock=1,1,1,0", "mock=0,1,1,0",
       "mock=4,1,1,0", "mock=9,1,1,0", "mock=1,1,1,1"]),
-    ("lazy_recover_10_10k_die_hard", "lazy_recover", 10,
+    ("lazy_recover_10_10k_die_hard", "lazy_recover", 10, 6,
      ["10000", "mock=0,0,1,0", "mock=1,1,1,0", "mock=1,1,1,1",
       "mock=0,1,1,0", "mock=4,1,1,0", "mock=9,1,1,0", "mock=8,1,2,0",
       "mock=4,1,3,0"]),
-    ("lazy_recover_10_10k_die_same", "lazy_recover", 10,
+    ("lazy_recover_10_10k_die_same", "lazy_recover", 10, 4,
      ["10000", "mock=0,0,1,0", "mock=1,1,1,0", "mock=0,1,1,0",
       "mock=4,1,1,0", "mock=9,1,1,0"]),
-    ("ringallreduce_10_10k", "model_recover", 10,
+    ("ringallreduce_10_10k", "model_recover", 10, 0,
      ["10000", "rabit_reduce_ring_mincount=10"]),
 ]
 
 QUICK = [
-    ("model_recover_4_1k_quick", "model_recover", 4,
+    ("model_recover_4_1k_quick", "model_recover", 4, 2,
      ["1000", "mock=0,0,1,0", "mock=1,1,1,0", "rabit_bootstrap_cache=-1"]),
-    ("local_recover_4_1k_quick", "local_recover", 4,
+    ("local_recover_4_1k_quick", "local_recover", 4, 1,
      ["1000", "mock=2,1,1,0"]),
 ]
 
@@ -75,22 +81,29 @@ def main() -> int:
     shim = os.path.join(REPO, "tools", "dmlc_tracker_shim.py")
     rows = []
     failed = False
+    env = dict(os.environ)
+    # strip the axon sitecustomize dir: a wedged TPU relay can hang
+    # interpreter startup of every spawned python (shim + workers)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p) or REPO
     with tempfile.TemporaryDirectory() as wd:
         binaries = {}
         for prog in {s[1] for s in scenarios}:
             binaries[prog] = build_reference(wd, test_src=prog, mock=True)
-        for name, prog, world, sargs in scenarios:
+        for name, prog, world, expect_respawns, sargs in scenarios:
             t0 = time.perf_counter()
             out = subprocess.run(
                 [sys.executable, shim, "-n", str(world),
                  "--max-attempts", "20", binaries[prog], *sargs],
-                capture_output=True, text=True, timeout=600)
+                capture_output=True, text=True, timeout=600, env=env)
             dt = time.perf_counter() - t0
             respawns = out.stderr.count("[ref-launcher] worker")
-            ok = out.returncode == 0
+            ok = out.returncode == 0 and respawns == expect_respawns
             failed = failed or not ok
             rows.append({"scenario": name, "world": world,
                          "rc": out.returncode, "respawns": respawns,
+                         "expected_respawns": expect_respawns,
                          "seconds": round(dt, 2)})
             print(json.dumps(rows[-1]), flush=True)
             if not ok:
