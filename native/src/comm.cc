@@ -24,6 +24,9 @@ void Comm::SetupFromConfig(const Config& cfg) {
       "rabit_num_trial", cfg.GetInt("rabit_num_attempt", 0)));
   ring_mincount_ = static_cast<size_t>(
       cfg.GetInt("rabit_reduce_ring_mincount", 32 << 10));
+  // explicit setting pins the crossover; only the DEFAULT is subject
+  // to the same-host adjustment (see TryAllreduce)
+  ring_user_set_ = !cfg.Get("rabit_reduce_ring_mincount").empty();
   reduce_buffer_ = cfg.GetSize("rabit_reduce_buffer", 256u << 20);
   debug_ = cfg.GetBool("rabit_debug", false);
   // an accelerator data plane will be registered after Init (the Python
@@ -127,6 +130,10 @@ void Comm::ReconnectLinks(const char* cmd) {
   world_epoch_ = t.RecvU32();
   coord_host_ = t.RecvStr();
   coord_port_ = static_cast<int>(t.RecvU32());
+  // tracker-computed, hence IDENTICAL on every rank: a per-rank guess
+  // from local link addresses could diverge in mixed-host worlds and
+  // deadlock a collective on mismatched tree/ring algorithms
+  all_local_peers_ = t.RecvU32() != 0;
   uint32_t parent_rank = t.RecvU32();
   uint32_t ntree = t.RecvU32();
   std::vector<int> tree_ranks(ntree);
@@ -263,8 +270,12 @@ void Comm::LazyCheckpoint(const std::string*) { ++version_; }
 NetResult Comm::TryAllreduce(void* buf, size_t elem_size, size_t count,
                              ReduceFn reducer) {
   if (world_ == 1 || count == 0) return NetResult::kOk;
-  // the crossover the reference documents but never wires (SURVEY §2 #3)
-  if (count >= ring_mincount_ && world_ > 2) {
+  // the crossover the reference documents but never wires (SURVEY §2 #3);
+  // same-host worlds default to the streaming tree at every size (links
+  // share one medium — see ReconnectLinks), unless the user pinned the
+  // crossover explicitly
+  if (count >= ring_mincount_ && world_ > 2 &&
+      (ring_user_set_ || !all_local_peers_)) {
     return TryAllreduceRing(static_cast<char*>(buf), elem_size, count,
                             reducer);
   }

@@ -162,6 +162,13 @@ class Comm {
   std::string tracker_uri_;
   int tracker_port_ = 9091;
   size_t ring_mincount_ = 32 << 10;   // reference default 32K elements
+  bool ring_user_set_ = false;        // crossover set explicitly?
+  // tracker-announced "whole world is on one host" (shared medium: the
+  // ring's 2(p-1) serialized phases lose to the streaming tree, so the
+  // crossover DEFAULT prefers tree there — measured up to ~1.6x at
+  // 16 MB, world 8, loaded single host). Tracker-computed so every rank
+  // decides identically.
+  bool all_local_peers_ = false;
   size_t reduce_buffer_ = 256u << 20; // reference default 256MB
   bool debug_ = false;
   // advertise at tracker registration that a data plane will be

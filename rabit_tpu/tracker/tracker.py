@@ -19,7 +19,9 @@ Wire protocol (binary, little-endian, length-prefixed strings):
   tracker -> worker (start/recover): rank u32, world u32, epoch u32,
     coord_host str, coord_port u32 (this epoch's tracker-hosted device
     -world coordination service; empty/0 when coordinator hosting is
-    off), parent u32 (0xFFFFFFFF = none), ntree u32 + tree neighbor
+    off), single_host u32 (1 when every registered worker reported the
+    same host — drives the world-consistent ring/tree crossover
+    default), parent u32 (0xFFFFFFFF = none), ntree u32 + tree neighbor
     ranks, ring_prev u32, ring_next u32,
     nconnect u32 + (peer_rank u32, host str, port u32)..., naccept u32;
     worker replies ready u32 after wiring its links.
@@ -337,6 +339,11 @@ class Tracker:
                 except OSError:
                     pass
             return
+        # Single-host worlds get a flag so every rank makes the SAME
+        # collective-algorithm choice (the ring/tree crossover default
+        # prefers tree on a shared medium; a per-rank local-links guess
+        # could diverge in mixed-host worlds and deadlock a collective)
+        single_host = len({h for (c, h, p, f) in batch.values()}) <= 1
         for rank in sorted(batch):
             conn = conns[rank]
             parent, children = tree_neighbors(rank, world)
@@ -354,6 +361,7 @@ class Tracker:
                 _send_u32(conn, epoch)
                 _send_str(conn, coord_host)
                 _send_u32(conn, coord_port)
+                _send_u32(conn, 1 if single_host else 0)
                 _send_u32(conn, NO_RANK if parent is None else parent)
                 _send_u32(conn, len(tree_nbrs))
                 for r in tree_nbrs:

@@ -64,7 +64,8 @@ class FakeWorker:
         s = self.sock
         out = {"rank": _recv_u32(s), "world": _recv_u32(s),
                "epoch": _recv_u32(s), "coord_host": _recv_str(s),
-               "coord_port": _recv_u32(s), "parent": _recv_u32(s)}
+               "coord_port": _recv_u32(s),
+               "single_host": _recv_u32(s), "parent": _recv_u32(s)}
         ntree = _recv_u32(s)
         out["tree"] = [_recv_u32(s) for _ in range(ntree)]
         out["ring_prev"], out["ring_next"] = _recv_u32(s), _recv_u32(s)

@@ -20,7 +20,14 @@ import rabit_tpu as rabit  # noqa: E402
 
 
 def main() -> None:
-    rabit.init(engine=os.environ.get("WORKER_ENGINE", "native"))
+    # pin the ring crossover explicitly: the same-host DEFAULT now
+    # prefers the streaming tree, and this worker exists to cover BOTH
+    # collective algorithms (the m=50000 ops below exercise the ring).
+    # argv key=value params still pass through (the default init reads
+    # them from sys.argv; appending must not drop them).
+    rabit.init([a for a in sys.argv[1:] if "=" in a] +
+               ["rabit_reduce_ring_mincount=32768"],
+               engine=os.environ.get("WORKER_ENGINE", "native"))
     rank = rabit.get_rank()
     world = rabit.get_world_size()
     assert rabit.is_distributed()
