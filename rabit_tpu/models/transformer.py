@@ -150,8 +150,17 @@ def _shard_forward(params: Params, tokens: jax.Array, sp_axis: str,
     ``ident_psum_grad``/``psum_identity_grad`` pins correct gradients."""
     t_loc = tokens.shape[1]
     pos_ids = lax.axis_index(sp_axis) * t_loc + jnp.arange(t_loc)
+    # RABIT_FLASH_ATTN=1 routes the per-block online-softmax update
+    # through the Pallas flash kernels (fwd + fused bwd) instead of the
+    # XLA-fused twin; harmless where pallas is unavailable (the ring
+    # falls back to the twin). Off by default pending the committed
+    # HW measurement of kernel-vs-XLA chain throughput
+    # (tools/kernel_hw_proof.py flash_vs_xla_blockwise).
+    import os
+    use_pallas = os.environ.get("RABIT_FLASH_ATTN") == "1"
     attn = jax.vmap(functools.partial(
-        ring_attention, axis_name=sp_axis, causal=True))
+        ring_attention, axis_name=sp_axis, causal=True,
+        use_pallas=use_pallas))
     if checked:
         enter = lambda x: x  # noqa: E731
         combine = lambda x: lax.psum(x, tp_axis)  # noqa: E731

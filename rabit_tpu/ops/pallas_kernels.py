@@ -345,7 +345,22 @@ def flash_block_bwd(q, k, v, m, l, o, mask_i8, sm_scale, cm, cl, co):
                    _out_struct((h, t, d), jnp.float32, *ins)],
         interpret=_interpret(),
     )(*ins)
-    return dq, dk, dv, dm[..., 0], dl[..., 0], do
+
+    def match_vma(g, primal):
+        # custom_vjp requires each grad's varying-manual-axes to equal
+        # its primal's. A primal replicated over an axis (e.g. the ring
+        # scan's m0/l0/o0 init constants under a checked shard_map) gets
+        # a cotangent varying over it; the broadcast's true transpose is
+        # a psum over the extra axes — exactly what differentiating the
+        # jnp twin produces automatically.
+        want = getattr(jax.typeof(primal), "vma", None) or frozenset()
+        have = getattr(jax.typeof(g), "vma", None) or frozenset()
+        extra = tuple(sorted(have - set(want)))
+        return jax.lax.psum(g, extra) if extra else g
+
+    grads = (dq, dk, dv, dm[..., 0], dl[..., 0], do)
+    return tuple(match_vma(g, p)
+                 for g, p in zip(grads, (q, k, v, m, l, o)))
 
 
 def flash_block(q, k, v, m, l, o, mask, sm_scale):

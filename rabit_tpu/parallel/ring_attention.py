@@ -116,6 +116,25 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
     if p == 1:
+        if use_pallas:
+            # single-shard worlds still honor the flash opt-in: one
+            # block update through the Pallas kernels (fwd + fused bwd)
+            # plus the normalization — otherwise a 1-chip run silently
+            # measures XLA attention while claiming the kernel path
+            from ..ops.pallas_kernels import (flash_block,
+                                              flash_block_available)
+            if flash_block_available():
+                qh = q.transpose(1, 0, 2)
+                pos = jnp.arange(t)
+                mask = (pos[None, :] > pos[:, None]) if causal else None
+                m0 = jnp.full(qh.shape[:2], _NEG_INF, jnp.float32)
+                l0 = jnp.zeros(qh.shape[:2], jnp.float32)
+                o0 = jnp.zeros(qh.shape, jnp.float32)
+                m0, l0, o0 = flash_block(qh, k.transpose(1, 0, 2),
+                                         v.transpose(1, 0, 2),
+                                         m0, l0, o0, mask, sm_scale)
+                out = o0 / l0[..., None]
+                return out.transpose(1, 0, 2).astype(q.dtype)
         return reference_attention(q, k, v, causal, sm_scale)
 
     qh = q.transpose(1, 0, 2)                      # [H, T, D]

@@ -72,6 +72,7 @@ def main() -> None:
     payload = {
         "backend": backend,
         "device": str(jax.devices()[0]),
+        "flash_attn": os.environ.get("RABIT_FLASH_ATTN") == "1",
         "model": {"layers": 2, "d_model": 256, "heads": 8, "d_ff": 1024,
                   "seq_len": 512, "batch": 8, "vocab": 256},
         "losses": losses,

@@ -32,6 +32,14 @@ if [ -f tools/boosted_bench.py ]; then
   echo "boosted_bench rc=$?" | tee -a "$LOG"
 fi
 
+# Flagship training on-chip: default attention vs the Pallas flash path
+# (fwd + fused bwd) — decides whether RABIT_FLASH_ATTN should become
+# the flagship default.
+timeout 1200 python tools/flagship_hw_proof.py >>"$LOG" 2>&1
+echo "flagship(default) rc=$?" | tee -a "$LOG"
+RABIT_FLASH_ATTN=1 timeout 1200 python tools/flagship_hw_proof.py >>"$LOG" 2>&1
+echo "flagship(flash) rc=$?" | tee -a "$LOG"
+
 echo "=== suite done; artifacts: ===" | tee -a "$LOG"
 ls -t BENCH_LOCAL_*.json KERNEL_HW_*.json HIST_SWEEP_*.json \
-  BOOSTED_BENCH_*.json 2>/dev/null | head -8 | tee -a "$LOG"
+  BOOSTED_BENCH_*.json FLAGSHIP_HW_*.json 2>/dev/null | head -10 | tee -a "$LOG"
