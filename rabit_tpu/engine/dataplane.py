@@ -117,6 +117,18 @@ class XlaDataPlane:
         fail_at = os.environ.get("RABIT_DATAPLANE_FAIL_AT")
         self._fail_at: Optional[int] = int(fail_at) if fail_at else None
         self._invocations = 0
+        # EQuARX-style wire quantization for ring-path float SUMs
+        # (rabit_dataplane_wire = bf16 | int8): compresses only the
+        # ppermute'd ICI bytes; accumulation stays full-precision and
+        # all ranks end bit-identical (the replay-buffer contract)
+        wire = os.environ.get("RABIT_DATAPLANE_WIRE", "")
+        if wire and wire not in ("bf16", "int8"):
+            # a typo must not silently run uncompressed while the user
+            # believes the wire is quantized
+            raise ValueError(
+                f"rabit_dataplane_wire must be 'bf16' or 'int8', "
+                f"got {wire!r}")
+        self._wire: Optional[str] = wire or None
         # keep the ctypes callback object alive for the C side
         self.c_callback = DATAPLANE_CB(self._invoke)
 
@@ -284,7 +296,8 @@ class XlaDataPlane:
             local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
             xs = jax.make_array_from_single_device_arrays(
                 (self._world, n), sharding, [local])
-            out = device_allreduce(xs, mesh, op, axis="proc")
+            out = device_allreduce(xs, mesh, op, axis="proc",
+                                   wire=self._wire)
             res = np.asarray(out.addressable_data(0)).reshape(-1)
         if res.dtype != buf.dtype:
             raise TypeError(

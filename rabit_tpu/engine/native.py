@@ -111,6 +111,7 @@ class NativeEngine(Engine):
         self._loaded = False
         self._dataplane_kind = dataplane
         self._dataplane = None
+        self._wire_exported = False
 
     def _cache_key(self, site: str, size: int) -> bytes:
         """Deterministic replay key: caller site + payload size + an
@@ -151,6 +152,14 @@ class NativeEngine(Engine):
         self._check(self._lib.RbtInit(len(argv), arr), "init")
         if kind == "xla" and self.is_distributed:
             from .dataplane import XlaDataPlane
+            # config param -> env so the data plane (and any respawned
+            # process) sees one consistent wire setting; tracked so
+            # finalize can clear it — an engine configured WITHOUT the
+            # param must not inherit a previous engine's value
+            wire = cfg.get("rabit_dataplane_wire", "")
+            if wire:
+                os.environ["RABIT_DATAPLANE_WIRE"] = wire
+                self._wire_exported = True
             self._dataplane = XlaDataPlane(
                 self._lib,
                 init_timeout=cfg.get_int("rabit_dataplane_init_timeout", 60))
@@ -181,6 +190,11 @@ class NativeEngine(Engine):
             # ordering between ranks is needed (see dataplane.py)
             self._dataplane.shutdown()
             self._dataplane = None
+        if self._wire_exported:
+            # do not leak this engine's wire setting into a later
+            # engine in the same process that didn't configure one
+            os.environ.pop("RABIT_DATAPLANE_WIRE", None)
+            self._wire_exported = False
         self._check(self._lib.RbtFinalize(), "finalize")
 
     def allreduce(self, buf: np.ndarray, op: int,

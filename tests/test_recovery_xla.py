@@ -170,3 +170,14 @@ def test_shutdown_fence_serves_straggler():
     # finishers' result logs hold device-produced tail results and must
     # be replayed to the respawned straggler from inside finalize()
     assert run_xla(4, "straggler_worker.py") == 0
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_quantized_wire_data_plane(wire):
+    """EQuARX-style wire quantization end to end through the robust+XLA
+    engine (rabit_dataplane_wire): float SUMs land within the wire's
+    error envelope and BIT-IDENTICAL on every rank — the property that
+    keeps result-log replay consistent under a compressed wire."""
+    assert run_xla(4, "wire_worker.py",
+                   extra_args=[f"rabit_dataplane_wire={wire}"],
+                   env={"RABIT_DATAPLANE_WIRE": wire}) == 0
