@@ -179,3 +179,11 @@ def test_shutdown_fence_serves_straggler():
 def test_shutdown_fence_straggler_is_tree_root():
     # victim 0 is the tree root — the respawn reroutes every replay
     assert run_cluster(4, "straggler_worker.py", env={"VICTIM": "0"}) == 0
+
+
+def test_shutdown_fence_serves_checkpoint_load():
+    # N_TAIL=0: the victim dies right after the final checkpoint, so its
+    # respawn needs a checkpoint LOAD (not replay) served by ranks
+    # already inside finalize() — the reference Shutdown's
+    # pseudo-checkpoint kLoadCheck service (allreduce_robust.cc:54-60)
+    assert run_cluster(4, "straggler_worker.py", env={"N_TAIL": "0"}) == 0
