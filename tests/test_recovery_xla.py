@@ -181,3 +181,17 @@ def test_quantized_wire_data_plane(wire):
     assert run_xla(4, "wire_worker.py",
                    extra_args=[f"rabit_dataplane_wire={wire}"],
                    env={"RABIT_DATAPLANE_WIRE": wire}) == 0
+
+
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_quantized_wire_survives_recovery(wire):
+    """Quantized wire + mock kill: the respawned rank's collectives are
+    served from the survivors' result logs, and with a compressed wire
+    those cached (quantized-sum) results must land byte-equal to what
+    every survivor holds — checked per round via CRC MIN==MAX. int8 is
+    the format where replay byte-drift is most plausible (per-block
+    scale computation), so both modes run."""
+    assert run_xla(4, "wire_worker.py",
+                   extra_args=[f"rabit_dataplane_wire={wire}",
+                               "mock=1,1,0,0"],
+                   env={"RABIT_DATAPLANE_WIRE": wire, "N_ITER": "3"}) == 0

@@ -20,14 +20,9 @@ import numpy as np  # noqa: E402
 import rabit_tpu as rabit  # noqa: E402
 
 
-def main() -> None:
-    rabit.init()
-    rank = rabit.get_rank()
-    world = rabit.get_world_size()
-    wire = os.environ.get("RABIT_DATAPLANE_WIRE", "none")
+def _check_round(rank: int, world: int, wire: str, it: int) -> None:
     rtol = {"bf16": 2e-2, "int8": 5e-2}.get(wire, 1e-6)
-
-    rng = np.random.default_rng(40 + rank)
+    rng = np.random.default_rng(40 + rank + 1000 * it)
     # big enough for the ring path and a whole number of int8 blocks
     n = world * 8192
     x = rng.standard_normal(n).astype(np.float32)
@@ -36,17 +31,36 @@ def main() -> None:
     # exact expectation recomputed locally from every rank's seed
     want = np.zeros(n, np.float64)
     for r in range(world):
-        want += np.random.default_rng(40 + r).standard_normal(n)
+        want += np.random.default_rng(
+            40 + r + 1000 * it).standard_normal(n)
     np.testing.assert_allclose(
         got, want, rtol=rtol, atol=rtol * np.abs(want).max(),
-        err_msg=f"wire={wire} result outside error envelope")
+        err_msg=f"wire={wire} result outside error envelope (it {it})")
 
     import zlib
     digest = float(zlib.crc32(got.tobytes()))   # order-sensitive
     hi = rabit.allreduce(np.array([digest]), rabit.MAX)
     lo = rabit.allreduce(np.array([digest]), rabit.MIN)
     assert hi[0] == lo[0] == digest, \
-        f"wire={wire}: ranks disagree byte-wise (replay contract broken)"
+        f"wire={wire} it {it}: ranks disagree byte-wise (replay " \
+        f"contract broken — a respawned rank's replayed result must " \
+        f"equal what survivors hold)"
+
+
+def main() -> None:
+    rabit.init()
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+    wire = os.environ.get("RABIT_DATAPLANE_WIRE", "none")
+    n_iter = int(os.environ.get("N_ITER", "1"))
+
+    # checkpointed loop (mock kills via argv exercise recovery: the
+    # respawn's quantized-sum results come back through result-log
+    # REPLAY and must be byte-equal to the survivors' copies)
+    version, _ = rabit.load_checkpoint()
+    for it in range(version, n_iter):
+        _check_round(rank, world, wire, it)
+        rabit.checkpoint({"it": it + 1})
 
     rabit.tracker_print(f"wire_worker rank {rank}/{world} wire={wire} ok")
     rabit.finalize()
