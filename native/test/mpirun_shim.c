@@ -38,10 +38,22 @@ extern int orte_submit_job(char *cmd[], int *index,
                            void *complete_cbdata);
 extern int orte_submit_finalize(void);
 extern int orte_finalize(void);
+
+/* the real RML buffer-receive callback signature (orte/mca/rml/rml.h):
+   (status, peer, buffer, tag, cbdata) — declared exactly so the
+   registration below is well-defined C, not an ABI-coincidence cast */
+struct opal_buffer_t;
+typedef void (*orte_rml_buffer_callback_fn_t)(int status,
+                                              orte_process_name_t *peer,
+                                              struct opal_buffer_t *buffer,
+                                              uint32_t tag, void *cbdata);
 extern void orte_rml_API_recv_buffer_nb(orte_process_name_t *peer,
                                         uint32_t tag, bool persistent,
-                                        void (*cb)(void), void *cbdata);
-extern void orte_daemon_recv(void);
+                                        orte_rml_buffer_callback_fn_t cb,
+                                        void *cbdata);
+extern void orte_daemon_recv(int status, orte_process_name_t *sender,
+                             struct opal_buffer_t *buffer, uint32_t tag,
+                             void *cbdata);
 extern int event_base_loop(struct event_base *, int);
 #define EVLOOP_ONCE 0x01
 
@@ -70,8 +82,7 @@ int main(int argc, char *argv[])
         return 1;
     /* listen for daemon commands sent to the HNP itself (see header) */
     orte_rml_API_recv_buffer_nb(&orte_name_wildcard, ORTE_RML_TAG_DAEMON,
-                                ORTE_RML_PERSISTENT,
-                                (void (*)(void))orte_daemon_recv, NULL);
+                                ORTE_RML_PERSISTENT, orte_daemon_recv, NULL);
     rc = orte_submit_job(argv, &idx, launched, NULL, completed, NULL);
     if (rc != 0)
         return 1;

@@ -112,6 +112,8 @@ class NativeEngine(Engine):
         self._dataplane_kind = dataplane
         self._dataplane = None
         self._wire_exported = False
+        self._wire_prev = None
+        self._wire_value = None
 
     def _cache_key(self, site: str, size: int) -> bytes:
         """Deterministic replay key: caller site + payload size + an
@@ -127,6 +129,35 @@ class NativeEngine(Engine):
         n = self._key_counts.get(base, 0)
         self._key_counts[base] = n + 1
         return f"{base}@{n}".encode()
+
+    def _export_wire(self, wire: str) -> None:
+        """config param -> env so the data plane (and any respawned
+        process) sees one consistent wire setting; tracked so finalize
+        can undo it — an engine configured WITHOUT the param must not
+        inherit a previous engine's value, while a value the user set
+        independently in the environment must survive finalize."""
+        if wire:
+            if not self._wire_exported:
+                # first export only: a retried init must not snapshot
+                # the engine's own exported value as "the user's"
+                self._wire_prev = os.environ.get("RABIT_DATAPLANE_WIRE")
+            os.environ["RABIT_DATAPLANE_WIRE"] = wire
+            self._wire_value = wire
+            self._wire_exported = True
+
+    def _restore_wire(self) -> None:
+        # only touch the var if it still holds OUR export — if another
+        # owner (the public API is a per-process singleton, but engines
+        # are per-thread internally) overwrote it meanwhile, it is no
+        # longer ours to restore
+        if self._wire_exported:
+            if os.environ.get("RABIT_DATAPLANE_WIRE") == self._wire_value:
+                if self._wire_prev is None:
+                    os.environ.pop("RABIT_DATAPLANE_WIRE", None)
+                else:
+                    os.environ["RABIT_DATAPLANE_WIRE"] = self._wire_prev
+            self._wire_prev = None
+            self._wire_exported = False
 
     def _check(self, rc: int, what: str) -> None:
         if rc != 0:
@@ -152,14 +183,7 @@ class NativeEngine(Engine):
         self._check(self._lib.RbtInit(len(argv), arr), "init")
         if kind == "xla" and self.is_distributed:
             from .dataplane import XlaDataPlane
-            # config param -> env so the data plane (and any respawned
-            # process) sees one consistent wire setting; tracked so
-            # finalize can clear it — an engine configured WITHOUT the
-            # param must not inherit a previous engine's value
-            wire = cfg.get("rabit_dataplane_wire", "")
-            if wire:
-                os.environ["RABIT_DATAPLANE_WIRE"] = wire
-                self._wire_exported = True
+            self._export_wire(cfg.get("rabit_dataplane_wire", ""))
             self._dataplane = XlaDataPlane(
                 self._lib,
                 init_timeout=cfg.get_int("rabit_dataplane_init_timeout", 60))
@@ -190,11 +214,7 @@ class NativeEngine(Engine):
             # ordering between ranks is needed (see dataplane.py)
             self._dataplane.shutdown()
             self._dataplane = None
-        if self._wire_exported:
-            # do not leak this engine's wire setting into a later
-            # engine in the same process that didn't configure one
-            os.environ.pop("RABIT_DATAPLANE_WIRE", None)
-            self._wire_exported = False
+        self._restore_wire()
         self._check(self._lib.RbtFinalize(), "finalize")
 
     def allreduce(self, buf: np.ndarray, op: int,

@@ -33,6 +33,9 @@ def slope_time(run_fn, k_small: int, k_big: int, *, salt_base: int = 100,
     """
     import numpy as np
 
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+
     def timed(k: int, salt: int) -> float:
         np.asarray(run_fn(k, salt))          # compile + warm
         best = float("inf")
@@ -48,7 +51,15 @@ def slope_time(run_fn, k_small: int, k_big: int, *, salt_base: int = 100,
         if t_big > t_small * 1.2:
             return (t_big - t_small) / (k_big - k_small)
     if allow_noisy:                           # CI smoke: quality moot
-        return max(t_big - t_small, 1e-9) / (k_big - k_small)
+        # the diff is noise (possibly negative); publish the whole-batch
+        # per-iteration mean instead — an over-estimate that still
+        # includes the dispatch floor, so a noisy value can never be
+        # mistaken for an absurdly fast device measurement
+        import warnings
+        warnings.warn(
+            "slope_time: unstable measurement; returning noisy upper "
+            "bound t_big/k_big (smoke-quality only)", RuntimeWarning)
+        return t_big / k_big
     raise RuntimeError(
         f"slope measurement unstable after {attempts} attempts "
         f"(t{k_small}={t_small:.4f}s t{k_big}={t_big:.4f}s)")

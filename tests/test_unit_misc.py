@@ -1,0 +1,116 @@
+"""Unit pins for small cross-cutting behaviors flagged by review:
+
+- the engine's RABIT_DATAPLANE_WIRE export must restore (not delete) a
+  value the user set independently in the environment before init;
+- slope_time must reject attempts < 1 up front, and its allow_noisy
+  fallback must publish a conservative over-estimate (never the
+  absurdly-fast value a negative timing diff would produce).
+"""
+
+import os
+
+import pytest
+
+
+def _engine():
+    from rabit_tpu.engine.native import NativeEngine
+    return NativeEngine.__new__(NativeEngine)  # no lib load needed
+
+
+def _fresh_wire_state(eng):
+    eng._wire_exported = False
+    eng._wire_prev = None
+    eng._wire_value = None
+
+
+def test_wire_export_restores_preexisting_env(monkeypatch):
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "int8")
+    eng = _engine()
+    _fresh_wire_state(eng)
+    eng._export_wire("bf16")
+    assert os.environ["RABIT_DATAPLANE_WIRE"] == "bf16"
+    eng._restore_wire()
+    # the user's independently-set value survives finalize
+    assert os.environ["RABIT_DATAPLANE_WIRE"] == "int8"
+
+
+def test_wire_export_cleans_up_when_env_was_unset(monkeypatch):
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
+    eng = _engine()
+    _fresh_wire_state(eng)
+    eng._export_wire("bf16")
+    assert os.environ["RABIT_DATAPLANE_WIRE"] == "bf16"
+    eng._restore_wire()
+    assert "RABIT_DATAPLANE_WIRE" not in os.environ
+
+
+def test_wire_double_export_keeps_original_snapshot(monkeypatch):
+    """A retried init() (e.g. after a dataplane failure) exports twice
+    before restore; the snapshot must stay the USER's value, not the
+    engine's own first export."""
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
+    eng = _engine()
+    _fresh_wire_state(eng)
+    eng._export_wire("bf16")
+    eng._export_wire("bf16")  # retried init
+    eng._restore_wire()
+    assert "RABIT_DATAPLANE_WIRE" not in os.environ
+
+
+def test_wire_noop_when_param_absent(monkeypatch):
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "int8")
+    eng = _engine()
+    _fresh_wire_state(eng)
+    eng._export_wire("")
+    eng._restore_wire()
+    assert os.environ["RABIT_DATAPLANE_WIRE"] == "int8"
+
+
+def test_wire_restore_skips_foreign_value(monkeypatch):
+    """If another owner overwrote the var after our export, restore
+    must leave it alone — it is no longer ours."""
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
+    eng = _engine()
+    _fresh_wire_state(eng)
+    eng._export_wire("bf16")
+    os.environ["RABIT_DATAPLANE_WIRE"] = "int8"  # someone else's export
+    eng._restore_wire()
+    assert os.environ["RABIT_DATAPLANE_WIRE"] == "int8"
+    del os.environ["RABIT_DATAPLANE_WIRE"]
+
+
+def test_slope_rejects_zero_attempts():
+    from rabit_tpu.utils.slope import slope_time
+    with pytest.raises(ValueError, match="attempts"):
+        slope_time(lambda k, s: 0.0, 1, 8, attempts=0)
+
+
+def test_slope_noisy_fallback_is_conservative():
+    """A run where big is no costlier than small (pure noise) must not
+    publish an absurdly fast slope; the fallback is the whole-batch
+    per-iteration mean, which still contains the dispatch floor."""
+    import time
+
+    from rabit_tpu.utils.slope import slope_time
+
+    def run(k, salt):  # big batch strictly CHEAPER: guaranteed noise
+        time.sleep(0.02 if k == 4 else 0.01)
+        return 0.0
+
+    with pytest.warns(RuntimeWarning, match="noisy"):
+        val = slope_time(run, 4, 8, attempts=1, reps=1, allow_noisy=True)
+    # >= t_big/k_big ~ 10ms/8; far above the ~0 a clamped diff would give
+    assert val >= 0.01 / 8 * 0.5
+
+
+def test_slope_unstable_raises_without_optin():
+    import time
+
+    from rabit_tpu.utils.slope import slope_time
+
+    def run(k, salt):  # big batch strictly cheaper: never "stable"
+        time.sleep(0.01 if k == 4 else 0.005)
+        return 0.0
+
+    with pytest.raises(RuntimeError, match="unstable"):
+        slope_time(run, 4, 8, attempts=1, reps=1)
