@@ -1,8 +1,10 @@
 #include "comm.h"
 
 #include <algorithm>
+#include <chrono>
 #include <cstring>
 #include <map>
+#include <thread>
 
 namespace rt {
 
@@ -86,7 +88,33 @@ void Comm::TrackerPrint(const std::string& msg) {
 }
 
 TcpConn Comm::ConnectTrackerCmd(const std::string& cmd) {
-  TcpConn t = TcpConn::Connect(tracker_uri_, tracker_port_);
+  // Reference parity (allreduce_base.cc:231-242): absorb transient
+  // connection refusals from a tracker that is restarting or saturated
+  // by a simultaneous re-registration storm, instead of killing a
+  // worker the tracker would have saved. Budget is tunable via
+  // rabit_connect_retry / DMLC_WORKER_CONNECT_RETRY (default 5), with
+  // the reference's escalating sleep(2*retry) between attempts
+  // (~20 s total at the default) — the inner per-attempt retry is
+  // disabled for the tracker so this loop owns the whole budget.
+  long budget = cfg_.GetInt("rabit_connect_retry",
+                            cfg_.GetInt("rabit_worker_connect_retry", 5));
+  if (budget < 1) budget = 1;
+  // resolve once: only CONNECT refusals are transient — a bad hostname
+  // fails identically every attempt and should surface immediately
+  std::string addr = TcpConn::ResolveHost(tracker_uri_);
+  TcpConn t;
+  for (long retry = 1;; ++retry) {
+    try {
+      t = TcpConn::Connect(addr, tracker_port_, /*retries=*/0);
+      break;
+    } catch (const rt::Error&) {
+      if (retry >= budget) throw;
+      rt::LogInfo(rt::StrFormat(
+          "retry connect to tracker %s:%d (attempt %ld/%ld)",
+          tracker_uri_.c_str(), tracker_port_, retry, budget));
+      std::this_thread::sleep_for(std::chrono::seconds(2 * retry));
+    }
+  }
   t.SendU32(kTrackerMagic);
   t.SendStr(cmd);
   t.SendStr(task_id_);

@@ -34,7 +34,9 @@ class Config {
     static const char* kEnv[] = {
         "DMLC_TASK_ID", "DMLC_NUM_ATTEMPT", "DMLC_TRACKER_URI",
         "DMLC_TRACKER_PORT", "DMLC_WORKER_STOP_PROCESS_ON_ERROR",
+        "DMLC_WORKER_CONNECT_RETRY",
         "RABIT_TASK_ID", "RABIT_TRACKER_URI", "RABIT_TRACKER_PORT",
+        "RABIT_CONNECT_RETRY", "rabit_connect_retry",
         "RABIT_NUM_TRIAL", "RABIT_BOOTSTRAP_CACHE", "RABIT_DEBUG",
         "RABIT_WORLD_SIZE", "rabit_world_size",
         "RABIT_REDUCE_RING_MINCOUNT", "rabit_reduce_ring_mincount",

@@ -49,6 +49,13 @@ static sockaddr_in ResolveV4(const std::string& host, int port) {
   return addr;
 }
 
+std::string TcpConn::ResolveHost(const std::string& host) {
+  sockaddr_in addr = ResolveV4(host, 0);
+  char buf[INET_ADDRSTRLEN] = {0};
+  inet_ntop(AF_INET, &addr.sin_addr, buf, sizeof(buf));
+  return std::string(buf);
+}
+
 TcpConn TcpConn::Connect(const std::string& host, int port, int retries,
                          int delay_ms) {
   sockaddr_in addr = ResolveV4(host, port);
@@ -63,7 +70,7 @@ TcpConn TcpConn::Connect(const std::string& host, int port, int retries,
     ::close(fd);
     if (attempt >= retries) {
       Fail(StrFormat("connect %s:%d failed after %d attempts: %s",
-                     host.c_str(), port, retries, strerror(errno)));
+                     host.c_str(), port, attempt + 1, strerror(errno)));
     }
     std::this_thread::sleep_for(std::chrono::milliseconds(delay_ms));
   }

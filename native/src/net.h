@@ -33,6 +33,10 @@ class TcpConn {
 
   static TcpConn Connect(const std::string& host, int port,
                          int retries = 30, int delay_ms = 200);
+  // hostname -> dotted-quad, throwing on failure: callers that retry
+  // Connect can resolve ONCE up front so a permanently bad name fails
+  // fast instead of being re-resolved per attempt
+  static std::string ResolveHost(const std::string& host);
 
   bool ok() const { return fd_ >= 0; }
   int fd() const { return fd_; }

@@ -155,6 +155,9 @@ def test_tracker_death_fails_worker_cleanly(tmp_path):
     tr = Tracker(1).start()
     env = dict(os.environ)
     env.update(tr.env(task_id="0"))
+    # a DEAD tracker is permanent: skip the (reference-parity) refused-
+    # connect backoff so the worker's error surfaces within the window
+    env["RABIT_CONNECT_RETRY"] = "1"
     p = subprocess.Popen([sys.executable, str(prog), str(flag)], env=env,
                          stderr=subprocess.PIPE)
     try:
@@ -167,6 +170,83 @@ def test_tracker_death_fails_worker_cleanly(tmp_path):
         assert p.returncode != 0, "worker must fail once the tracker died"
         assert b"tracker" in err.lower() or b"connect" in err.lower() or \
             b"error" in err.lower(), err[-500:]
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
+def _retry_worker(tmp_path):
+    prog = tmp_path / "w.py"
+    prog.write_text(
+        "import sys\n"
+        f"sys.path.insert(0, {ROOT!r})\n"
+        "import numpy as np\n"
+        "import rabit_tpu as rabit\n"
+        "rabit.init(sys.argv[1:])\n"
+        "out = rabit.allreduce(np.ones(4, dtype=np.float32), rabit.SUM)\n"
+        "assert out[0] == rabit.get_world_size()\n"
+        "rabit.finalize()\n"
+    )
+    return prog
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_connect_retry_absorbs_delayed_tracker_listen(tmp_path):
+    """Reference parity (allreduce_base.cc:231-242): a worker whose
+    first tracker connect is refused — respawn racing the tracker's
+    accept loop, or a re-registration storm — must retry with backoff
+    (rabit_connect_retry, default 5) instead of dying."""
+    prog = _retry_worker(tmp_path)
+    port = _free_port()
+    env = dict(os.environ)
+    env.update({"RABIT_TRACKER_URI": "127.0.0.1",
+                "RABIT_TRACKER_PORT": str(port),
+                "RABIT_TASK_ID": "0", "RABIT_NUM_TRIAL": "0",
+                "RABIT_WORLD_SIZE": "1"})
+    p = subprocess.Popen([sys.executable, str(prog)], env=env,
+                         stderr=subprocess.PIPE)
+    tr = None
+    try:
+        # the worker's first connect attempts hit a dead port; the
+        # tracker appears several seconds in, within the retry budget
+        time.sleep(7.0)
+        tr = Tracker(1, port=port).start()
+        _out, err = p.communicate(timeout=60)
+        assert p.returncode == 0, err[-800:]
+    finally:
+        if p.poll() is None:
+            p.kill()
+        if tr is not None:
+            tr.stop()
+
+
+def test_connect_retry_budget_of_one_fails_fast(tmp_path):
+    """rabit_connect_retry=1 restores fail-on-first-refusal, proving
+    the outer retry loop (not some hidden wait) is what absorbs the
+    delayed listen above."""
+    prog = _retry_worker(tmp_path)
+    env = dict(os.environ)
+    env.update({"RABIT_TRACKER_URI": "127.0.0.1",
+                "RABIT_TRACKER_PORT": str(_free_port()),  # never listens
+                "RABIT_TASK_ID": "0", "RABIT_NUM_TRIAL": "0",
+                "RABIT_WORLD_SIZE": "1"})
+    t0 = time.monotonic()
+    p = subprocess.Popen([sys.executable, str(prog), "rabit_connect_retry=1"],
+                         env=env, stderr=subprocess.PIPE)
+    try:
+        _out, err = p.communicate(timeout=30)
+        assert p.returncode != 0
+        assert b"connect" in err.lower(), err[-500:]
+        # no backoff sleeps happened (budget 1): well under the ~20 s
+        # a default budget would take
+        assert time.monotonic() - t0 < 15.0
     finally:
         if p.poll() is None:
             p.kill()
