@@ -10,6 +10,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 from tests.test_integration import ROOT
 
 
@@ -130,6 +132,32 @@ def test_kernel_hw_proof_smoke_contract():
     # evidence artifacts from real runs are expected to exist)
     fresh = set(os.listdir(ROOT)) - before
     assert not [p for p in fresh if p.startswith("KERNEL_HW")], fresh
+
+
+@pytest.mark.skipif(
+    not all(os.path.isfile(os.path.join(ROOT, "native", "build", b))
+            for b in ("speed_test", "mpirun", "orted")),
+    reason="speed_test / launcher shims not built")
+def test_socket_vs_mpi_smoke_contract():
+    """tools/socket_vs_mpi.py (the reference's speed_test.mpi role) must
+    run the same speed_test binary through BOTH launch paths — tracker/
+    socket and mpirun-shim/MPI — at smoke sizes without shedding an
+    artifact."""
+    env = _hermetic_env()
+    before = set(os.listdir(ROOT))
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "socket_vs_mpi.py"),
+         "--smoke"], capture_output=True, timeout=900, env=env, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout.decode()[-2000:],
+                                 out.stderr.decode()[-2000:])
+    text = out.stdout.decode()
+    assert text.strip().endswith("smoke ok")
+    rows = [json.loads(ln) for ln in text.splitlines()
+            if ln.startswith("{")]
+    assert rows and all(r["socket_mbs"]["sum"] > 0 and
+                        r["mpi_mbs"]["sum"] > 0 for r in rows)
+    fresh = set(os.listdir(ROOT)) - before
+    assert not [p for p in fresh if p.startswith("SOCKET_VS_MPI")], fresh
 
 
 def test_boosted_bench_smoke_contract():

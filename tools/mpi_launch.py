@@ -1,0 +1,61 @@
+"""Shared launcher scaffolding for running multi-rank MPI on this
+runtime-only OpenMPI image (libmpi.so.40 ships, launcher binaries do
+not — they are reconstructed from libopen-rte's exported machinery:
+native/test/orted_shim.c, native/test/mpirun_shim.c).
+
+One recipe, two consumers — tests/test_mpi_engine.py and
+tools/socket_vs_mpi.py — so a future MCA knob or prefix-layout change
+cannot silently fix one and break the other.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Dict, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "native", "build")
+MPIRUN = os.path.join(BUILD, "mpirun")
+ORTED = os.path.join(BUILD, "orted")
+
+
+def scaffold_mpi(scaffold_dir: str, *,
+                 yield_when_idle: bool = True) -> Tuple[Dict[str, str], str]:
+    """Environment + mpirun path for launching multi-rank MPI jobs.
+
+    On a full MPI install (orted on PATH) the shim mpirun is used
+    directly with the ambient environment. Otherwise an OPAL_PREFIX is
+    scaffolded in ``scaffold_dir`` mirroring /usr's lib+share with the
+    shim-built orted and mpirun copied in, so libopen-rte's launcher
+    machinery finds its daemons and help files.
+
+    Returns ``(env, mpirun_path)`` — callers must exec the returned
+    path, never re-derive it from the env (an ambient OPAL_PREFIX from
+    a relocated OpenMPI install must not redirect the launch).
+    """
+    env = dict(os.environ)
+    env.update({
+        "OMPI_MCA_plm_rsh_agent": "/bin/true",
+        "OMPI_ALLOW_RUN_AS_ROOT": "1",
+        "OMPI_ALLOW_RUN_AS_ROOT_CONFIRM": "1",
+    })
+    if yield_when_idle:
+        # oversubscribed single-core VM: keep the busy-poll from
+        # starving the other ranks' time-slices
+        env["OMPI_MCA_mpi_yield_when_idle"] = "1"
+    if shutil.which("orted") is not None or not os.path.isfile(ORTED):
+        # full MPI install, or shims not built (singleton launches —
+        # which need no daemon — still work with the plain env)
+        return env, MPIRUN
+    prefix = os.path.join(scaffold_dir, "prefix")
+    os.makedirs(os.path.join(prefix, "bin"), exist_ok=True)
+    for d in ("lib", "share"):
+        link = os.path.join(prefix, d)
+        if not os.path.exists(link):
+            os.symlink(os.path.join("/usr", d), link)
+    shutil.copy2(ORTED, os.path.join(prefix, "bin", "orted"))
+    mpirun = os.path.join(prefix, "bin", "mpirun")
+    shutil.copy2(MPIRUN, mpirun)
+    env["OPAL_PREFIX"] = prefix
+    return env, mpirun

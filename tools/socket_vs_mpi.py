@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Socket-vs-MPI collective speed head-to-head (the reference's
+``speed_test.mpi`` role: test/Makefile:60-62 builds the same speed test
+against the MPI engine, and test/speed_runner.py runs both for an
+apples-to-apples throughput cross-check).
+
+Ours needs no second binary: ``rabit_engine`` is a runtime selector
+(native/src/capi.cc), so the SAME ``speed_test`` executable runs its
+identical payload loop against
+
+- the socket engine, launched by the tracker
+  (``python -m rabit_tpu.tracker.launch``), and
+- the MPI engine (native/src/engine_mpi.h over the system OpenMPI
+  runtime), launched by the mpirun reconstructed from libopen-rte
+  (native/test/mpirun_shim.c), ``--oversubscribe`` +
+  ``mpi_yield_when_idle`` because this VM has one core.
+
+Expectation is context, not victory: oversubscribed MPI on one core
+measures semantics overhead, not fabric — the numbers exist so the
+second implementation's performance role is filled, as the reference's
+is (its MPI build is likewise a correctness/able-to-run cross-check on
+a laptop).
+
+Writes SOCKET_VS_MPI_<ts>.json at the repo root.
+Usage: python tools/socket_vs_mpi.py [--quick | --smoke]
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD = os.path.join(REPO, "native", "build")
+SPEED = os.path.join(BUILD, "speed_test")
+MPIRUN = os.path.join(BUILD, "mpirun")
+ORTED = os.path.join(BUILD, "orted")
+
+
+def parse_speed(stdout: str) -> dict:
+    res = {}
+    for name, key in (("allreduce.sum", "sum"), ("allreduce.max", "max"),
+                      ("broadcast", "bcast")):
+        m = re.search(rf"{re.escape(name)}\s+mean\s+([\d.]+)s.*?"
+                      rf"([\d.]+) MB/s", stdout)
+        assert m, (name, stdout[-2000:])
+        res[key] = float(m.group(2))
+    return res
+
+
+def run_socket(world: int, ndata: int, nrep: int) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-m", "rabit_tpu.tracker.launch", "-n", str(world),
+         SPEED, f"ndata={ndata}", f"nrep={nrep}"],
+        capture_output=True, text=True, timeout=900, cwd=REPO,
+        env=dict(os.environ, PYTHONPATH=REPO))
+    assert out.returncode == 0, out.stderr[-2000:]
+    return parse_speed(out.stdout)
+
+
+def run_mpi(world: int, ndata: int, nrep: int, env: dict,
+            mpirun: str) -> dict:
+    out = subprocess.run(
+        [mpirun, "--oversubscribe", "-n", str(world), SPEED,
+         f"ndata={ndata}", f"nrep={nrep}", "rabit_engine=mpi"],
+        capture_output=True, text=True, timeout=900, cwd=REPO, env=env)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    return parse_speed(out.stdout)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--quick", action="store_true",
+                    help="one config only")
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI contract check: tiny sizes, no artifact")
+    args = ap.parse_args()
+
+    for path, what in ((SPEED, "speed_test"), (MPIRUN, "mpirun shim"),
+                       (ORTED, "orted shim")):
+        if not os.path.isfile(path):
+            print(f"SKIP: {what} not built at {path}", file=sys.stderr)
+            sys.exit(0 if args.smoke else 1)
+
+    if args.smoke:
+        grid = [(2, 1024, 3)]
+    elif args.quick:
+        grid = [(2, 100000, 20)]
+    else:
+        # reference speed_runner.py grid shape: small (latency-bound)
+        # and large (bandwidth-bound) payloads at worlds 2 and 4
+        grid = [(w, n, 20) for w in (2, 4) for n in (10000, 1000000)]
+
+    from mpi_launch import scaffold_mpi
+    rows = []
+    with tempfile.TemporaryDirectory() as tmp:
+        env, mpirun = scaffold_mpi(tmp)
+        for world, ndata, nrep in grid:
+            sock = run_socket(world, ndata, nrep)
+            mpi = run_mpi(world, ndata, nrep, env, mpirun)
+            row = {"world": world, "ndata": ndata, "nrep": nrep,
+                   "bytes": ndata * 4, "socket_mbs": sock, "mpi_mbs": mpi}
+            rows.append(row)
+            print(json.dumps(row))
+
+    if args.smoke:
+        print("smoke ok")
+        return
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ")
+    artifact = {
+        "benchmark": "same speed_test binary, socket engine under the "
+                     "tracker vs MPI engine under the mpirun shim, "
+                     "one host, oversubscribed single core",
+        "note": "MPI numbers are a second-implementation semantics "
+                "cross-check, not a fabric measurement (no real "
+                "multi-core/multi-host MPI on this image)",
+        "rows": rows,
+    }
+    path = os.path.join(REPO, f"SOCKET_VS_MPI_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()
