@@ -56,6 +56,9 @@ def scaffold_mpi(scaffold_dir: str, *,
             os.symlink(os.path.join("/usr", d), link)
     shutil.copy2(ORTED, os.path.join(prefix, "bin", "orted"))
     mpirun = os.path.join(prefix, "bin", "mpirun")
-    shutil.copy2(MPIRUN, mpirun)
+    if os.path.isfile(MPIRUN):
+        shutil.copy2(MPIRUN, mpirun)
+    # else: returned path does not exist; launcher-needing callers all
+    # gate on the shim binaries up front (singletons need neither)
     env["OPAL_PREFIX"] = prefix
     return env, mpirun

@@ -36,11 +36,10 @@ import subprocess
 import sys
 import tempfile
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BUILD = os.path.join(REPO, "native", "build")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from mpi_launch import BUILD, MPIRUN, ORTED, REPO, scaffold_mpi  # noqa: E402
+
 SPEED = os.path.join(BUILD, "speed_test")
-MPIRUN = os.path.join(BUILD, "mpirun")
-ORTED = os.path.join(BUILD, "orted")
 
 
 def parse_speed(stdout: str) -> dict:
@@ -97,7 +96,6 @@ def main() -> None:
         # and large (bandwidth-bound) payloads at worlds 2 and 4
         grid = [(w, n, 20) for w in (2, 4) for n in (10000, 1000000)]
 
-    from mpi_launch import scaffold_mpi
     rows = []
     with tempfile.TemporaryDirectory() as tmp:
         env, mpirun = scaffold_mpi(tmp)
