@@ -160,6 +160,32 @@ def test_socket_vs_mpi_smoke_contract():
     assert not [p for p in fresh if p.startswith("SOCKET_VS_MPI")], fresh
 
 
+def test_wire_bench_smoke_contract():
+    """tools/wire_bench.py (VERDICT r4 #7) must run both phases — the
+    tracker-launched XLA-plane timing across wire modes and the
+    encode/decode overhead slope — at smoke sizes, artifact-free."""
+    env = _hermetic_env()
+    before = set(os.listdir(ROOT))
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "wire_bench.py"),
+         "--smoke"], capture_output=True, timeout=900, env=env, cwd=ROOT)
+    assert out.returncode == 0, (out.stdout.decode()[-2000:],
+                                 out.stderr.decode()[-2000:])
+    text = out.stdout.decode()
+    assert text.strip().endswith("smoke ok")
+    rows = [json.loads(ln) for ln in text.splitlines() if ln.startswith("{")]
+    host = [r for r in rows if "s_per_op" in r]
+    dev = [r for r in rows if "s_per_iter" in r]
+    assert {r["wire"] for r in host} == {"none", "bf16", "int8"}
+    assert {r["wire"] for r in dev} == {"none", "bf16", "int8"}
+    # the analytic hop-bytes column is the design claim being measured
+    by_wire = {r["wire"]: r["hop_bytes"] for r in host}
+    assert by_wire["bf16"] * 2 == by_wire["none"]
+    assert by_wire["int8"] < by_wire["none"] // 3
+    fresh = set(os.listdir(ROOT)) - before
+    assert not [p for p in fresh if p.startswith("WIRE_BENCH")], fresh
+
+
 def test_boosted_bench_smoke_contract():
     """tools/boosted_bench.py (VERDICT r3 #7) must run both phases —
     8 tracker-launched boosting workers and the kernel-build slope —

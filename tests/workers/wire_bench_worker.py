@@ -1,0 +1,54 @@
+"""Timing worker for the wire-quantization byte-savings bench
+(tools/wire_bench.py): K repeated float-SUM allreduces of an n-element
+payload through the tracker-launched XLA data plane, wire mode from the
+environment. Rank 0 prints one machine-readable line; correctness is
+asserted against the analytic sum so a broken wire path cannot "win"
+the timing.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init()
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+    wire = os.environ.get("RABIT_DATAPLANE_WIRE", "none")
+    n = int(os.environ.get("WIRE_BENCH_N", "65536"))
+    k = int(os.environ.get("WIRE_BENCH_K", "10"))
+
+    base = np.linspace(-1.0, 1.0, n).astype(np.float32)
+    want1 = base * world  # every rank contributes the same payload
+    rtol = {"bf16": 2e-2, "int8": 5e-2}.get(wire, 1e-5)
+
+    out = rabit.allreduce(base.copy(), rabit.SUM)  # warm
+    np.testing.assert_allclose(out, want1, rtol=rtol, atol=rtol * world)
+
+    t0 = time.perf_counter()
+    for it in range(k):
+        out = rabit.allreduce(base.copy(), rabit.SUM)
+    elapsed = time.perf_counter() - t0
+    np.testing.assert_allclose(out, want1, rtol=rtol, atol=rtol * world)
+
+    if rank == 0:
+        print("WIREBENCH " + json.dumps({
+            "wire": wire, "world": world, "n": n, "k": k,
+            "s_per_op": elapsed / k}), flush=True)
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,14 @@ if [ -f tools/boosted_bench.py ]; then
   echo "boosted_bench rc=$?" | tee -a "$LOG"
 fi
 
+# Wire-quantization encode/decode overhead on-chip (the per-hop compute
+# a multi-chip ring pays to move fewer bytes; host phase already
+# captured in WIRE_BENCH_* artifacts).
+if [ -f tools/wire_bench.py ]; then
+  timeout 900 python tools/wire_bench.py --tpu-only >>"$LOG" 2>&1
+  echo "wire_bench(tpu) rc=$?" | tee -a "$LOG"
+fi
+
 # Flagship training on-chip: default attention vs the Pallas flash path
 # (fwd + fused bwd) — decides whether RABIT_FLASH_ATTN should become
 # the flagship default.
@@ -42,4 +50,5 @@ echo "flagship(flash) rc=$?" | tee -a "$LOG"
 
 echo "=== suite done; artifacts: ===" | tee -a "$LOG"
 ls -t BENCH_LOCAL_*.json KERNEL_HW_*.json HIST_SWEEP_*.json \
-  BOOSTED_BENCH_*.json FLAGSHIP_HW_*.json 2>/dev/null | head -10 | tee -a "$LOG"
+  BOOSTED_BENCH_*.json FLAGSHIP_HW_*.json WIRE_BENCH_*.json \
+  2>/dev/null | head -12 | tee -a "$LOG"
