@@ -133,7 +133,8 @@ void Comm::CloseLinks() {
 void Comm::ReconnectLinks(const char* cmd) {
   CloseLinks();
   if (listener_.fd() < 0) {
-    listener_.Bind(static_cast<int>(cfg_.GetInt("rabit_slave_port", 9010)));
+    listener_.Bind(static_cast<int>(cfg_.GetInt("rabit_slave_port", 9010)),
+                   1000, cfg_.GetBool("rabit_local_uds", true));
   }
   TcpConn t = ConnectTrackerCmd(cmd);
   t.SendStr(host_);
@@ -175,7 +176,19 @@ void Comm::ReconnectLinks(const char* cmd) {
     int peer = static_cast<int>(t.RecvU32());
     std::string phost = t.RecvStr();
     int pport = static_cast<int>(t.RecvU32());
-    TcpConn c = TcpConn::Connect(phost, pport);
+    // Same-host peers skip the loopback TCP stack via the listener's
+    // abstract-UDS twin (keyed by the TCP port). Gated on the
+    // TRACKER-attested single-host flag (observed registration source
+    // IPs), not hostname equality: cloned VMs can share a hostname
+    // across machines, and connecting to the local socket that merely
+    // shares the remote peer's port number would deadlock or
+    // cross-wire the handshake. Any failed local connect (other
+    // netns, twin unavailable, rabit_local_uds=0) falls back to TCP.
+    TcpConn c;
+    if (all_local_peers_ && cfg_.GetBool("rabit_local_uds", true)) {
+      c = TcpConn::ConnectLocal(pport);
+    }
+    if (!c.ok()) c = TcpConn::Connect(phost, pport);
     c.SendU32(kLinkMagic);
     c.SendU32(static_cast<uint32_t>(rank_));
     uint32_t got = c.RecvU32();

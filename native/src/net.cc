@@ -6,8 +6,11 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
+#include <stddef.h>
 #include <string.h>
 #include <sys/socket.h>
+#include <sys/un.h>
 #include <unistd.h>
 
 #include <thread>
@@ -182,7 +185,29 @@ ssize_t TcpConn::TryRecv(void* data, size_t n, NetResult* res) {
   return -1;
 }
 
-void Listener::Bind(int port_start, int ntrial) {
+// abstract-namespace address a listener on TCP port `port` pairs with:
+// sun_path[0] == '\0', name carries no filesystem state
+static socklen_t LocalAddr(int port, sockaddr_un* addr) {
+  memset(addr, 0, sizeof(*addr));
+  addr->sun_family = AF_UNIX;
+  int n = snprintf(addr->sun_path + 1, sizeof(addr->sun_path) - 1,
+                   "rabit_tpu.%d", port);
+  return static_cast<socklen_t>(offsetof(sockaddr_un, sun_path) + 1 + n);
+}
+
+TcpConn TcpConn::ConnectLocal(int port) {
+  sockaddr_un addr;
+  socklen_t len = LocalAddr(port, &addr);
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return TcpConn();
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), len) != 0) {
+    ::close(fd);
+    return TcpConn();  // caller falls back to TCP
+  }
+  return TcpConn(fd);
+}
+
+void Listener::Bind(int port_start, int ntrial, bool with_local) {
   fd_ = ::socket(AF_INET, SOCK_STREAM, 0);
   RT_CHECK(fd_ >= 0, "socket() failed");
   int one = 1;
@@ -203,6 +228,20 @@ void Listener::Bind(int port_start, int ntrial) {
       } else {
         port_ = p;
       }
+      // same-host fast-path twin, keyed by the TCP port every peer
+      // already learns from the tracker; best-effort — a failed bind
+      // (exotic netns restrictions) just leaves TCP-only service
+      if (!with_local) return;
+      ufd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+      if (ufd_ >= 0) {
+        sockaddr_un uaddr;
+        socklen_t ulen = LocalAddr(port_, &uaddr);
+        if (::bind(ufd_, reinterpret_cast<sockaddr*>(&uaddr), ulen) != 0 ||
+            ::listen(ufd_, 256) != 0) {
+          ::close(ufd_);
+          ufd_ = -1;
+        }
+      }
       return;
     }
   }
@@ -211,13 +250,25 @@ void Listener::Bind(int port_start, int ntrial) {
 
 TcpConn Listener::Accept() {
   for (;;) {
-    int fd = ::accept(fd_, nullptr, nullptr);
+    int fd;
+    if (ufd_ < 0) {
+      fd = ::accept(fd_, nullptr, nullptr);
+    } else {
+      pollfd pfds[2] = {{fd_, POLLIN, 0}, {ufd_, POLLIN, 0}};
+      int rc = ::poll(pfds, 2, -1);
+      if (rc < 0) {
+        if (errno == EINTR) continue;
+        Fail(StrFormat("accept poll failed: %s", strerror(errno)));
+      }
+      // UDS first: when both raced to readiness, prefer the fast path
+      fd = ::accept(pfds[1].revents & POLLIN ? ufd_ : fd_, nullptr, nullptr);
+    }
     if (fd >= 0) {
       TcpConn c(fd);
-      c.SetNoDelay();
+      c.SetNoDelay();  // no-op on AF_UNIX (setsockopt result ignored)
       return c;
     }
-    if (errno == EINTR) continue;
+    if (errno == EINTR || errno == ECONNABORTED) continue;
     Fail(StrFormat("accept failed: %s", strerror(errno)));
   }
 }
@@ -226,6 +277,10 @@ void Listener::Close() {
   if (fd_ >= 0) {
     ::close(fd_);
     fd_ = -1;
+  }
+  if (ufd_ >= 0) {
+    ::close(ufd_);
+    ufd_ = -1;
   }
 }
 

@@ -342,8 +342,19 @@ class Tracker:
         # Single-host worlds get a flag so every rank makes the SAME
         # collective-algorithm choice (the ring/tree crossover default
         # prefers tree on a shared medium; a per-rank local-links guess
-        # could diverge in mixed-host worlds and deadlock a collective)
-        single_host = len({h for (c, h, p, f) in batch.values()}) <= 1
+        # could diverge in mixed-host worlds and deadlock a collective).
+        # Judged by the OBSERVED registration source address, not the
+        # self-reported hostname: cloned VMs/containers can share a
+        # hostname across machines, and the engine also gates its
+        # same-host UDS fast path on this flag — a false positive there
+        # would connect a worker to the wrong machine's socket name.
+        def _src_ip(c):
+            try:
+                return c.getpeername()[0]
+            except OSError:
+                return None  # died pre-assignment; be conservative
+        single_host = len({_src_ip(c) for (c, h, p, f) in
+                           batch.values()}) <= 1
         for rank in sorted(batch):
             conn = conns[rank]
             parent, children = tree_neighbors(rank, world)

@@ -47,3 +47,54 @@ def test_basic_collectives(nworkers):
 def test_basic_collectives_robust_engine():
     assert run_cluster(4, "basic_worker.py",
                        env={"WORKER_ENGINE": "robust"}) == 0
+
+
+def _run_watching_unix_sockets(extra_args, port_base):
+    """Launch a world-3 basic_worker cluster on a distinctive listener
+    port range and sample /proc/net/unix for THIS cluster's
+    abstract-namespace link sockets while it runs (the file is
+    machine-global, so matching must be scoped to our ports or a
+    concurrent cluster on the host would bleed into the assertion).
+    Returns (returncode, saw_uds, stderr)."""
+    import time
+    cmd = [sys.executable, "-m", "rabit_tpu.tracker.launch", "-n", "3",
+           sys.executable, os.path.join(WORKERS, "basic_worker.py"),
+           f"rabit_slave_port={port_base}"]
+    cmd += list(extra_args)
+    # world 3 scans upward from port_base: our names are exactly these
+    names = {f"@rabit_tpu.{port_base + i}" for i in range(10)}
+    p = subprocess.Popen(cmd, env=dict(os.environ, PYTHONPATH=ROOT),
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    saw = False
+    try:
+        for _ in range(600):  # up to 60 s
+            if p.poll() is not None:
+                break
+            with open("/proc/net/unix") as f:
+                content = f.read()
+            if any(n in content for n in names):
+                saw = True
+            time.sleep(0.1)
+        out, err = p.communicate(timeout=120)
+        return p.returncode, saw, err
+    finally:
+        if p.poll() is None:
+            p.kill()
+
+
+def test_same_host_links_ride_uds():
+    """Same-host peers must use the listener's abstract-UDS twin (the
+    loopback-TCP-skipping fast path), visible as @rabit_tpu.<port>
+    entries in /proc/net/unix while the cluster runs."""
+    rc, saw, err = _run_watching_unix_sockets([], port_base=23450)
+    assert rc == 0, err[-800:]
+    assert saw, "no @rabit_tpu abstract sockets observed during the run"
+
+
+def test_rabit_local_uds_opt_out():
+    """rabit_local_uds=0 keeps every link on TCP (the A/B measurement
+    knob and escape hatch) and the cluster still passes."""
+    rc, saw, err = _run_watching_unix_sockets(["rabit_local_uds=0"],
+                                             port_base=23470)
+    assert rc == 0, err[-800:]
+    assert not saw, "UDS links present despite rabit_local_uds=0"

@@ -111,6 +111,15 @@ def run_tpu(smoke: bool) -> list:
     return rows
 
 
+def _write(result: dict) -> None:
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ")
+    path = os.path.join(REPO, f"WIRE_BENCH_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"wrote {path}")
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--host-only", action="store_true")
@@ -133,10 +142,15 @@ def main() -> None:
     if not args.host_only:
         try:
             rows = run_tpu(args.smoke)
-        except Exception as e:  # tunnel down: don't shed a hollow artifact
+        except Exception as e:
             print(f"tpu phase failed: {e}", file=sys.stderr)
             if args.smoke:
                 raise
+            if result.get("host"):
+                # minutes of completed host measurement: keep it (the
+                # artifact records the device phase as absent), then
+                # still exit nonzero so the failure is visible
+                _write(result)
             sys.exit(1)
         result["tpu"] = rows
         for r in rows:
@@ -158,12 +172,7 @@ def main() -> None:
                 result["host"] = old["host"]
                 result["host_from"] = os.path.basename(path)
                 break
-    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%Y%m%dT%H%M%SZ")
-    path = os.path.join(REPO, f"WIRE_BENCH_{ts}.json")
-    with open(path, "w") as f:
-        json.dump(result, f, indent=1)
-    print(f"wrote {path}")
+    _write(result)
 
 
 if __name__ == "__main__":
