@@ -157,10 +157,16 @@ def main() -> None:
             return acc + chain(fn, salt + i).sum()
         return jax.lax.fori_loop(0, k, one, jnp.float32(0))
 
-    def slope(run_fn, which, salt_base, k1=2, k2=8):
+    def slope(run_fn, which, salt_base):
         # shared dispatch-floor-cancelling methodology; noisy slopes
-        # fail loudly except in CI smoke runs
+        # fail loudly except in CI smoke runs. One chain is only ~1-3 ms
+        # of device work against a ~70 ms tunnel dispatch floor whose
+        # jitter is several ms, so the spread must be tens of chains
+        # for the slope to clear the noise (k2-k1=6 measured unstable:
+        # t2=70.8 ms vs t8=73.1 ms). Interpret-mode smoke is ~100x
+        # slower per chain, so it keeps the small spread.
         from rabit_tpu.utils.slope import slope_time
+        k1, k2 = (2, 8) if smoke else (8, 64)
         return slope_time(lambda k, s: run_fn(s, which, k), k1, k2,
                           salt_base=salt_base, allow_noisy=smoke)
 
