@@ -4,6 +4,7 @@
 #include <chrono>
 #include <cstring>
 #include <map>
+#include <set>
 #include <thread>
 
 namespace rt {
@@ -130,6 +131,27 @@ void Comm::CloseLinks() {
   links_up_ = false;
 }
 
+// Connector side of the link handshake: send magic + own rank, expect
+// the peer's rank back. The two non-OK outcomes are deliberately
+// distinct: a MISMATCH means we reached a listener that is not our
+// peer (stale token) — the real peer's accept loop has seen nothing
+// and is still waiting, so retrying over TCP is safe; a DEAD socket
+// means the peer itself failed mid-handshake, where a TCP retry could
+// arrive after the peer's accept loop already counted this connection
+// and exited — connecting into its backlog and hanging forever — so
+// death is surfaced to the caller's failure path (recovery) instead.
+enum class Handshake { kOk, kMismatch, kDead };
+static Handshake LinkHandshake(TcpConn* c, int self_rank, int expect_peer) {
+  try {
+    c->SendU32(kLinkMagic);
+    c->SendU32(static_cast<uint32_t>(self_rank));
+    return static_cast<int>(c->RecvU32()) == expect_peer
+               ? Handshake::kOk : Handshake::kMismatch;
+  } catch (const Error&) {
+    return Handshake::kDead;
+  }
+}
+
 void Comm::ReconnectLinks(const char* cmd) {
   CloseLinks();
   if (listener_.fd() < 0) {
@@ -147,6 +169,12 @@ void Comm::ReconnectLinks(const char* cmd) {
   uint32_t flags = 0;
   if (dataplane_intent_ || dataplane_ != nullptr) flags |= 1u;
   t.SendU32(flags);
+  // random name of this listener's UDS twin ("" = TCP-only): the
+  // tracker relays it to peers, and only a same-host/same-netns peer
+  // can resolve it — the token itself is the same-host proof, so no
+  // single-host inference (hostnames, source IPs) gates the fast path
+  t.SendStr(cfg_.GetBool("rabit_local_uds", true)
+                ? listener_.local_token() : std::string());
 
   // Assignment (tracker barriers until all world_size workers register,
   // so every peer below is already listening). epoch + coordinator: the
@@ -176,34 +204,68 @@ void Comm::ReconnectLinks(const char* cmd) {
     int peer = static_cast<int>(t.RecvU32());
     std::string phost = t.RecvStr();
     int pport = static_cast<int>(t.RecvU32());
-    // Same-host peers skip the loopback TCP stack via the listener's
-    // abstract-UDS twin (keyed by the TCP port). Gated on the
-    // TRACKER-attested single-host flag (observed registration source
-    // IPs), not hostname equality: cloned VMs can share a hostname
-    // across machines, and connecting to the local socket that merely
-    // shares the remote peer's port number would deadlock or
-    // cross-wire the handshake. Any failed local connect (other
-    // netns, twin unavailable, rabit_local_uds=0) falls back to TCP.
+    std::string ptoken = t.RecvStr();
+    // Same-host peers skip the loopback TCP stack via the peer
+    // listener's abstract-UDS twin. The twin's name is a random
+    // tracker-relayed token, so resolving it in this netns IS the
+    // same-host proof: a cross-host attempt fails instantly (no such
+    // name here) and falls back to TCP, per-pair — mixed-host worlds
+    // still get UDS between co-located pairs, and no inference
+    // (hostname, source IP — both spoofable by clones/SNAT) is
+    // trusted. The handshake double-checks the peer's rank: a
+    // mismatch (not our peer) retries over TCP; a socket that dies
+    // mid-handshake is peer death, owned by the failure path.
     TcpConn c;
-    if (all_local_peers_ && cfg_.GetBool("rabit_local_uds", true)) {
-      c = TcpConn::ConnectLocal(pport);
+    if (cfg_.GetBool("rabit_local_uds", true)) {
+      c = TcpConn::ConnectLocal(ptoken);
+      if (c.ok()) {
+        Handshake hs = LinkHandshake(&c, rank_, peer);
+        RT_CHECK(hs != Handshake::kDead,
+                 StrFormat("rank %d died during link handshake", peer));
+        if (hs != Handshake::kOk) c = TcpConn();  // kMismatch: not our peer
+      }
     }
-    if (!c.ok()) c = TcpConn::Connect(phost, pport);
-    c.SendU32(kLinkMagic);
-    c.SendU32(static_cast<uint32_t>(rank_));
-    uint32_t got = c.RecvU32();
-    RT_CHECK(static_cast<int>(got) == peer,
-             StrFormat("link handshake: expected rank %d got %u", peer, got));
+    if (!c.ok()) {
+      c = TcpConn::Connect(phost, pport);
+      RT_CHECK(LinkHandshake(&c, rank_, peer) == Handshake::kOk,
+               StrFormat("link handshake with rank %d failed", peer));
+    }
     conns.emplace(peer, std::move(c));
   }
+  // the tracker's naccept equals our higher-ranked neighbors; derive
+  // the expected set locally so an inbound claim can be validated
+  std::set<int> expect_accept;
+  for (int r : tree_ranks) if (r > rank_) expect_accept.insert(r);
+  if (world_ > 1) {
+    if (prev_rank > rank_) expect_accept.insert(prev_rank);
+    if (next_rank > rank_) expect_accept.insert(next_rank);
+  }
   uint32_t naccept = t.RecvU32();
-  for (uint32_t i = 0; i < naccept; ++i) {
+  RT_CHECK(expect_accept.size() == naccept,
+           StrFormat("tracker naccept %u != expected neighbor count %zu",
+                     naccept, expect_accept.size()));
+  for (uint32_t accepted = 0; accepted < naccept;) {
     TcpConn c = listener_.Accept();
-    uint32_t magic = c.RecvU32();
-    RT_CHECK(magic == kLinkMagic, "bad link magic");
-    int peer = static_cast<int>(c.RecvU32());
-    c.SendU32(static_cast<uint32_t>(rank_));
-    conns.emplace(peer, std::move(c));
+    // A bogus inbound connection (bad magic, unexpected rank, dies
+    // mid-handshake) is dropped without consuming an accept slot:
+    // aborting here — or counting it — would let one stray connect
+    // wedge the whole world. A REPEATED expected rank (peer abandoned
+    // a suspect connection and redialed) replaces the stale conn
+    // without recounting, so the loop still waits for every real peer.
+    uint32_t magic = 0, prank = 0;
+    try {
+      magic = c.RecvU32();
+      if (magic != kLinkMagic) continue;
+      prank = c.RecvU32();
+      c.SendU32(static_cast<uint32_t>(rank_));
+    } catch (const Error&) {
+      continue;
+    }
+    int pr = static_cast<int>(prank);
+    if (!expect_accept.count(pr)) continue;
+    bool fresh = conns.find(pr) == conns.end();
+    conns[pr] = std::move(c);  // newest wins: older twin was abandoned
+    if (fresh) ++accepted;
   }
   // Epoch advanced while a device world may be formed: tell the data
   // plane to drop its old client NOW, before the ready ack. Ordering

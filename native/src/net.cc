@@ -13,6 +13,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <random>
 #include <thread>
 
 #include "log.h"
@@ -185,19 +186,35 @@ ssize_t TcpConn::TryRecv(void* data, size_t n, NetResult* res) {
   return -1;
 }
 
-// abstract-namespace address a listener on TCP port `port` pairs with:
-// sun_path[0] == '\0', name carries no filesystem state
-static socklen_t LocalAddr(int port, sockaddr_un* addr) {
+// abstract-namespace address for a listener token: sun_path[0] == '\0',
+// name carries no filesystem state
+static socklen_t LocalAddr(const std::string& token, sockaddr_un* addr) {
   memset(addr, 0, sizeof(*addr));
   addr->sun_family = AF_UNIX;
   int n = snprintf(addr->sun_path + 1, sizeof(addr->sun_path) - 1,
-                   "rabit_tpu.%d", port);
+                   "rabit_tpu.%s", token.c_str());
   return static_cast<socklen_t>(offsetof(sockaddr_un, sun_path) + 1 + n);
 }
 
-TcpConn TcpConn::ConnectLocal(int port) {
+// 64 random bits, hex. Identity of the UDS twin: peers learn it only
+// through the tracker, so resolving it proves same host + same netns —
+// unlike a port-derived name, which any co-located world (or a worker
+// on another host behind the same SNAT, which fools source-IP
+// single-host inference) could coincidentally own.
+static std::string RandomToken() {
+  std::random_device rd;
+  uint64_t v = (static_cast<uint64_t>(rd()) << 32) ^ rd() ^
+               (static_cast<uint64_t>(::getpid()) << 17);
+  char buf[17];
+  snprintf(buf, sizeof(buf), "%016llx",
+           static_cast<unsigned long long>(v));
+  return std::string(buf);
+}
+
+TcpConn TcpConn::ConnectLocal(const std::string& token) {
+  if (token.empty()) return TcpConn();
   sockaddr_un addr;
-  socklen_t len = LocalAddr(port, &addr);
+  socklen_t len = LocalAddr(token, &addr);
   int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
   if (fd < 0) return TcpConn();
   if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), len) != 0) {
@@ -228,18 +245,23 @@ void Listener::Bind(int port_start, int ntrial, bool with_local) {
       } else {
         port_ = p;
       }
-      // same-host fast-path twin, keyed by the TCP port every peer
-      // already learns from the tracker; best-effort — a failed bind
-      // (exotic netns restrictions) just leaves TCP-only service
+      // same-host fast-path twin under a random token the tracker
+      // relays to peers; best-effort — a failed bind (exotic netns
+      // restrictions) just leaves TCP-only service
       if (!with_local) return;
       ufd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
       if (ufd_ >= 0) {
+        // port prefix for human observability (ss -x / /proc/net/unix
+        // group by world); the random suffix is the actual identity —
+        // connecting requires the full tracker-relayed name
+        token_ = StrFormat("%d.%s", port_, RandomToken().c_str());
         sockaddr_un uaddr;
-        socklen_t ulen = LocalAddr(port_, &uaddr);
+        socklen_t ulen = LocalAddr(token_, &uaddr);
         if (::bind(ufd_, reinterpret_cast<sockaddr*>(&uaddr), ulen) != 0 ||
             ::listen(ufd_, 256) != 0) {
           ::close(ufd_);
           ufd_ = -1;
+          token_.clear();
         }
       }
       return;
@@ -281,6 +303,7 @@ void Listener::Close() {
   if (ufd_ >= 0) {
     ::close(ufd_);
     ufd_ = -1;
+    token_.clear();
   }
 }
 

@@ -34,11 +34,13 @@ class TcpConn {
   static TcpConn Connect(const std::string& host, int port,
                          int retries = 30, int delay_ms = 200);
   // Same-host fast path: connect to the abstract-namespace unix socket
-  // a Listener on this host pairs with TCP port ``port``. Returns an
+  // a Listener advertised as ``token`` (tracker-relayed). Returns an
   // invalid conn (ok() == false) instead of throwing when no such
-  // socket exists — callers fall back to TCP (other netns, or a peer
-  // built without the UDS listener).
-  static TcpConn ConnectLocal(int port);
+  // socket exists in this network namespace — callers fall back to
+  // TCP. Because tokens are random per listener (not derived from the
+  // port), a cross-host or cross-netns attempt cannot accidentally
+  // reach an unrelated worker that shares the port number.
+  static TcpConn ConnectLocal(const std::string& token);
   // hostname -> dotted-quad, throwing on failure: callers that retry
   // Connect can resolve ONCE up front so a permanently bad name fails
   // fast instead of being re-resolved per attempt
@@ -83,6 +85,10 @@ class Listener {
   void Bind(int port_start, int ntrial = 1000, bool with_local = true);
   TcpConn Accept();   // whichever family is ready first
   int port() const { return port_; }
+  // Random per-listener name of the UDS twin ("" when disabled or
+  // bind failed). Workers advertise it through the tracker; peers that
+  // can resolve it in their netns are by construction same-host.
+  const std::string& local_token() const { return token_; }
   int fd() const { return fd_; }
   void Close();
   ~Listener() { Close(); }
@@ -91,6 +97,7 @@ class Listener {
   int fd_ = -1;
   int ufd_ = -1;  // abstract-namespace UDS twin; -1 when unavailable
   int port_ = 0;
+  std::string token_;
 };
 
 // poll(2) wrapper (reference PollHelper, socket.h:440-533).

@@ -134,6 +134,10 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
     ``wire`` compresses the ppermute'd bytes only (accumulation stays in
     the input dtype): "bf16" (~2x fewer ICI bytes, ~1e-2 rel err over a
     ring) or "int8" (block-scaled, ~4x, SUM only)."""
+    if x.ndim != 1:
+        raise ValueError(
+            f"ring_reduce_scatter takes a 1-D per-shard array, got "
+            f"shape {x.shape}; flatten first")
     p = lax.axis_size(axis_name)
     if p == 1:
         return x
@@ -223,6 +227,11 @@ def ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     full-precision on-device accumulation. All ranks still end
     bit-identical (the all-gather rounds the owner's chunk through the
     same encoding the hops use)."""
+    if x.ndim != 1:
+        raise ValueError(
+            f"ring_allreduce takes a 1-D per-shard array, got shape "
+            f"{x.shape}; flatten first (the chunking math silently "
+            "misreduces higher-rank inputs)")
     p = lax.axis_size(axis_name)
     if p == 1:
         return x

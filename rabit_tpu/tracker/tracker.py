@@ -14,7 +14,9 @@ Wire protocol (binary, little-endian, length-prefixed strings):
     start/recover: + host str, listen_port u32, flags u32
                    (flags bit 0: worker will register an accelerator
                    data plane — the tracker hosts a device-world
-                   coordinator on demand)
+                   coordinator on demand), uds_token str (random name
+                   of the worker's abstract-UDS listener twin; "" =
+                   TCP-only)
     print:         + msg str
   tracker -> worker (start/recover): rank u32, world u32, epoch u32,
     coord_host str, coord_port u32 (this epoch's tracker-hosted device
@@ -23,8 +25,12 @@ Wire protocol (binary, little-endian, length-prefixed strings):
     same host — drives the world-consistent ring/tree crossover
     default), parent u32 (0xFFFFFFFF = none), ntree u32 + tree neighbor
     ranks, ring_prev u32, ring_next u32,
-    nconnect u32 + (peer_rank u32, host str, port u32)..., naccept u32;
-    worker replies ready u32 after wiring its links.
+    nconnect u32 + (peer_rank u32, host str, port u32, uds_token
+    str)..., naccept u32; worker replies ready u32 after wiring its
+    links. A peer's uds_token resolves only on that peer's own host
+    and network namespace, so the UDS fast path needs no same-host
+    inference: resolving the name IS the proof, and failure falls back
+    to TCP per-pair.
 Workers connect to lower-ranked neighbors and accept from higher ranks.
 The epoch counts completed registration batches: every live worker
 re-registers in the same batch during recovery, so all members of a
@@ -117,7 +123,8 @@ class Tracker:
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._ranks: Dict[str, int] = {}        # task_id -> stable rank
-        self._pending: Dict[int, Tuple[socket.socket, str, int]] = {}
+        self._pending: Dict[int, Tuple[socket.socket, str, int, int,
+                               str]] = {}
         self._epoch = 0
         self._shutdown_ranks: set = set()
         self._done = threading.Event()
@@ -281,7 +288,8 @@ class Tracker:
                 host = _recv_str(conn)
                 port = _recv_u32(conn)
                 flags = _recv_u32(conn)
-                self._register(conn, task_id, host, port, flags)
+                token = _recv_str(conn)
+                self._register(conn, task_id, host, port, flags, token)
             else:
                 conn.close()
         except (ConnectionError, OSError, struct.error):
@@ -291,7 +299,7 @@ class Tracker:
                 pass
 
     def _register(self, conn, task_id: str, host: str, port: int,
-                  flags: int = 0) -> None:
+                  flags: int = 0, token: str = "") -> None:
         with self._cv:
             if task_id not in self._ranks:
                 self._ranks[task_id] = len(self._ranks)
@@ -300,7 +308,7 @@ class Tracker:
                 conn.close()
                 return
             self._shutdown_ranks.discard(rank)
-            self._pending[rank] = (conn, host, port, flags)
+            self._pending[rank] = (conn, host, port, flags, token)
             if len(self._pending) == self.nworkers:
                 batch = dict(self._pending)
                 self._pending.clear()
@@ -314,16 +322,18 @@ class Tracker:
                 return  # the completing thread serves everyone
         self._assign(batch, epoch)
 
-    def _assign(self, batch: Dict[int, Tuple[socket.socket, str, int, int]],
+    def _assign(self,
+                batch: Dict[int, Tuple[socket.socket, str, int, int,
+                                       str]],
                 epoch: int) -> None:
         world = self.nworkers
-        addr = {r: (h, p) for r, (c, h, p, f) in batch.items()}
-        conns = {r: c for r, (c, h, p, f) in batch.items()}
+        addr = {r: (h, p, tok) for r, (c, h, p, f, tok) in batch.items()}
+        conns = {r: c for r, (c, h, p, f, tok) in batch.items()}
         # host a coordinator when configured OR when any worker advertised
         # data-plane need in its registration flags (the Python engine API
         # path is invisible to the launcher's argv/env autodetect)
         want_coord = self._coordinator or any(
-            f & FLAG_DATAPLANE for (c, h, p, f) in batch.values())
+            f & FLAG_DATAPLANE for (c, h, p, f, tok) in batch.values())
         try:
             coord_host, coord_port = (self._new_coordinator(epoch)
                                       if want_coord else ("", 0))
@@ -345,15 +355,16 @@ class Tracker:
         # could diverge in mixed-host worlds and deadlock a collective).
         # Judged by the OBSERVED registration source address, not the
         # self-reported hostname: cloned VMs/containers can share a
-        # hostname across machines, and the engine also gates its
-        # same-host UDS fast path on this flag — a false positive there
-        # would connect a worker to the wrong machine's socket name.
+        # hostname across machines. The flag only steers that algorithm
+        # default — the UDS fast path does NOT trust it (source IPs
+        # collapse behind SNAT); it rides the per-peer random uds_token,
+        # which resolves only on the owning host.
         def _src_ip(c):
             try:
                 return c.getpeername()[0]
             except OSError:
                 return None  # died pre-assignment; be conservative
-        single_host = len({_src_ip(c) for (c, h, p, f) in
+        single_host = len({_src_ip(c) for (c, h, p, f, tok) in
                            batch.values()}) <= 1
         for rank in sorted(batch):
             conn = conns[rank]
@@ -384,6 +395,7 @@ class Tracker:
                     _send_u32(conn, r)
                     _send_str(conn, addr[r][0])
                     _send_u32(conn, addr[r][1])
+                    _send_str(conn, addr[r][2])
                 _send_u32(conn, naccept)
             except OSError:
                 pass

@@ -61,8 +61,10 @@ def _run_watching_unix_sockets(extra_args, port_base):
            sys.executable, os.path.join(WORKERS, "basic_worker.py"),
            f"rabit_slave_port={port_base}"]
     cmd += list(extra_args)
-    # world 3 scans upward from port_base: our names are exactly these
-    names = {f"@rabit_tpu.{port_base + i}" for i in range(10)}
+    # world 3 scans upward from port_base; socket names are
+    # @rabit_tpu.<port>.<random token>, so the port prefix scopes the
+    # match to THIS cluster while the suffix stays unpredictable
+    names = {f"@rabit_tpu.{port_base + i}." for i in range(10)}
     p = subprocess.Popen(cmd, env=dict(os.environ, PYTHONPATH=ROOT),
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE)
     saw = False

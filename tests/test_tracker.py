@@ -59,6 +59,7 @@ class FakeWorker:
         _send_str(self.sock, "127.0.0.1")
         _send_u32(self.sock, 9999)       # listen port (never used here)
         _send_u32(self.sock, flags)
+        _send_str(self.sock, "")         # uds token (TCP-only worker)
 
     def read_assignment(self):
         s = self.sock
@@ -71,7 +72,7 @@ class FakeWorker:
         out["ring_prev"], out["ring_next"] = _recv_u32(s), _recv_u32(s)
         nconn = _recv_u32(s)
         for _ in range(nconn):
-            _recv_u32(s), _recv_str(s), _recv_u32(s)
+            _recv_u32(s), _recv_str(s), _recv_u32(s), _recv_str(s)
         out["naccept"] = _recv_u32(s)
         return out
 
