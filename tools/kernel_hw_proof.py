@@ -42,7 +42,26 @@ def main() -> None:
 
     evidence = {"backend": backend,
                 "device": str(jax.devices()[0]),
-                "interpret_mode": smoke}
+                "interpret_mode": smoke,
+                "complete": False}
+
+    # Evidence is flushed to disk after EVERY stage: a tunnel drop or an
+    # unstable timing late in the run must not discard correctness
+    # results already proven on silicon (the 20260731 lesson — all six
+    # correctness stages passed, then one noisy slope threw away the
+    # artifact).
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ")
+    path = os.path.join(_REPO, f"KERNEL_HW_{ts}.json")
+
+    def flush():
+        if smoke:  # CI must not shed artifacts into the repo
+            return
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(dict(evidence, timestamp_utc=ts), f, indent=1)
+            f.write("\n")
+        os.replace(tmp, path)
 
     # --- histogram kernel (compiled Mosaic) -------------------------------
     # nbins=1024 takes the values-fused-into-hi-mask branch (8 hi
@@ -70,6 +89,7 @@ def main() -> None:
             evidence[key] = {
                 "rows": n, "nbins": nbins, "compile+run_s": round(dt, 3),
                 "max_abs_err": err, "correct": ok}
+            flush()
             print(f"histogram[{precision}, nbins={nbins}]: correct={ok} "
                   f"max_err={err:.5f}", flush=True)
             assert ok, f"histogram {precision}/{nbins} wrong on hardware"
@@ -118,6 +138,7 @@ def main() -> None:
         "forward_matches_jnp": fwd_ok,
         "grad_max_rel_err_vs_jnp": grad_err,
         "backward_matches_jnp": bwd_ok}
+    flush()
     print(f"flash_block: fwd={fwd_ok} bwd={bwd_ok} "
           f"grad_rel_err={grad_err:.2e}", flush=True)
     assert fwd_ok and bwd_ok, "flash_block wrong on hardware"
@@ -170,21 +191,28 @@ def main() -> None:
         return slope_time(lambda k, s: run_fn(s, which, k), k1, k2,
                           salt_base=salt_base, allow_noisy=smoke)
 
-    t_pallas = slope(run_chain, "pallas", 10)
-    t_jnp = slope(run_chain, "jnp", 20)
-    # correctness of the chained form vs the jnp twin
+    # correctness of the chained form vs the jnp twin FIRST: a noisy
+    # shared chip must not cost the parity evidence
     op = np.asarray(jax.jit(lambda: chain(flash_block, 0))())
     oj = np.asarray(jax.jit(lambda: chain(_block_update, 0))())
     chain_rel = float(np.abs(op - oj).max() / (np.abs(oj).max() + 1e-9))
-    evidence["flash_vs_xla_blockwise"] = {
-        "shape": [Hh, NBLK * T_BLK, D], "blocks": NBLK,
-        "pallas_ms_per_seq": round(t_pallas * 1e3, 3),
-        "xla_fused_ms_per_seq": round(t_jnp * 1e3, 3),
-        "pallas_over_xla": round(t_jnp / t_pallas, 2),
-        "chain_max_rel_err": chain_rel}
-    print(f"flash chain {NBLK}x{T_BLK}: pallas {t_pallas*1e3:.2f} ms vs "
-          f"xla {t_jnp*1e3:.2f} ms (x{t_jnp/t_pallas:.2f}), "
-          f"rel_err={chain_rel:.2e}", flush=True)
+    fwd_times = {"chain_max_rel_err": chain_rel}
+    try:
+        t_pallas = slope(run_chain, "pallas", 10)
+        t_jnp = slope(run_chain, "jnp", 20)
+        fwd_times.update(
+            pallas_ms_per_seq=round(t_pallas * 1e3, 3),
+            xla_fused_ms_per_seq=round(t_jnp * 1e3, 3),
+            pallas_over_xla=round(t_jnp / t_pallas, 2))
+        print(f"flash chain {NBLK}x{T_BLK}: pallas {t_pallas*1e3:.2f} ms "
+              f"vs xla {t_jnp*1e3:.2f} ms (x{t_jnp/t_pallas:.2f}), "
+              f"rel_err={chain_rel:.2e}", flush=True)
+    except RuntimeError as e:   # unstable slope on a shared chip
+        fwd_times["timing_error"] = str(e)
+        print(f"flash chain timing unstable: {e}", flush=True)
+    evidence["flash_vs_xla_blockwise"] = dict(
+        fwd_times, shape=[Hh, NBLK * T_BLK, D], blocks=NBLK)
+    flush()
     assert chain_rel < 1e-3, "chained flash_block wrong on hardware"
 
     # --- flash backward: fused Pallas kernel vs XLA twin (VERDICT r3 #3) --
@@ -203,9 +231,8 @@ def main() -> None:
             return acc + gq.sum() + gk.sum() + gv.sum()
         return jax.lax.fori_loop(0, k, one, jnp.float32(0))
 
-    t_bwd_pallas = slope(run_chain_bwd, "pallas", 30)
-    t_bwd_jnp = slope(run_chain_bwd, "jnp", 40)
-    # gradient parity of the two backends on hardware
+    # gradient parity of the two backends on hardware FIRST (same
+    # rationale as the forward chain: parity evidence survives noise)
     grads_p = jax.jit(jax.grad(
         lambda a, b, c: (chain(flash_block, 0, a, b, c) ** 2).sum(),
         argnums=(0, 1, 2)))(q8, kcat, vcat)
@@ -216,27 +243,31 @@ def main() -> None:
         float(np.abs(np.asarray(a) - np.asarray(b)).max()
               / (np.abs(np.asarray(b)).max() + 1e-9))
         for a, b in zip(grads_p, grads_j))
-    evidence["flash_bwd_fused_vs_xla"] = {
-        "shape": [Hh, NBLK * T_BLK, D], "blocks": NBLK,
-        "fused_fwdbwd_ms_per_seq": round(t_bwd_pallas * 1e3, 3),
-        "xla_fwdbwd_ms_per_seq": round(t_bwd_jnp * 1e3, 3),
-        "fused_over_xla": round(t_bwd_jnp / t_bwd_pallas, 2),
-        "grad_max_rel_err": bwd_rel}
-    print(f"flash fwd+bwd chain {NBLK}x{T_BLK}: fused {t_bwd_pallas*1e3:.2f} ms "
-          f"vs xla {t_bwd_jnp*1e3:.2f} ms "
-          f"(x{t_bwd_jnp/t_bwd_pallas:.2f}), rel_err={bwd_rel:.2e}",
-          flush=True)
+    bwd_times = {"grad_max_rel_err": bwd_rel}
+    try:
+        t_bwd_pallas = slope(run_chain_bwd, "pallas", 30)
+        t_bwd_jnp = slope(run_chain_bwd, "jnp", 40)
+        bwd_times.update(
+            fused_fwdbwd_ms_per_seq=round(t_bwd_pallas * 1e3, 3),
+            xla_fwdbwd_ms_per_seq=round(t_bwd_jnp * 1e3, 3),
+            fused_over_xla=round(t_bwd_jnp / t_bwd_pallas, 2))
+        print(f"flash fwd+bwd chain {NBLK}x{T_BLK}: fused "
+              f"{t_bwd_pallas*1e3:.2f} ms vs xla {t_bwd_jnp*1e3:.2f} ms "
+              f"(x{t_bwd_jnp/t_bwd_pallas:.2f}), rel_err={bwd_rel:.2e}",
+              flush=True)
+    except RuntimeError as e:
+        bwd_times["timing_error"] = str(e)
+        print(f"flash fwd+bwd chain timing unstable: {e}", flush=True)
+    evidence["flash_bwd_fused_vs_xla"] = dict(
+        bwd_times, shape=[Hh, NBLK * T_BLK, D], blocks=NBLK)
+    flush()
     assert bwd_rel < 1e-3, "fused flash backward wrong on hardware"
 
-    if smoke:  # CI must not shed artifacts into the repo
+    evidence["complete"] = True
+    flush()
+    if smoke:
         print("smoke ok")
         return
-    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
-        "%Y%m%dT%H%M%SZ")
-    path = os.path.join(_REPO, f"KERNEL_HW_{ts}.json")
-    with open(path, "w") as f:
-        json.dump(dict(evidence, timestamp_utc=ts), f, indent=1)
-        f.write("\n")
     print(f"wrote {path}")
 
 
