@@ -100,3 +100,51 @@ def test_rabit_local_uds_opt_out():
                                              port_base=23470)
     assert rc == 0, err[-800:]
     assert not saw, "UDS links present despite rabit_local_uds=0"
+
+
+def test_stray_connections_do_not_wedge_link_wiring():
+    """A stray process connecting to a worker's listener during link
+    wiring (port scanners, crash-looping respawns, health probes) must
+    not consume an accept slot or abort the world: the accept loop
+    validates the link magic and the claimed rank against the expected
+    higher-ranked-neighbor set and drops everything else. Before the
+    r5 hardening this aborted ('bad link magic') or hung (slot stolen).
+
+    The spammer floods the whole listener port range from BEFORE launch
+    so the garbage races link wiring itself, in three flavors: garbage
+    magic, valid magic + absurd rank, and connect-then-close."""
+    import socket
+    import struct
+    import threading
+    import time
+
+    port_base = 23490
+    stop = threading.Event()
+
+    def spam():
+        flavor = 0
+        while not stop.is_set():
+            for port in range(port_base, port_base + 6):
+                try:
+                    s = socket.create_connection(("127.0.0.1", port),
+                                                 timeout=0.2)
+                    if flavor == 0:
+                        s.sendall(b"GET / HTTP/1.0\r\n\r\n")
+                    elif flavor == 1:   # valid magic, bogus rank
+                        s.sendall(struct.pack("<II", 0x52425402, 999))
+                    # flavor 2: connect-then-close (dies mid-handshake)
+                    s.close()
+                except OSError:
+                    pass
+                flavor = (flavor + 1) % 3
+            time.sleep(0.005)
+
+    t = threading.Thread(target=spam, daemon=True)
+    t.start()
+    try:
+        rc = run_cluster(3, "basic_worker.py",
+                         extra_args=[f"rabit_slave_port={port_base}"])
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert rc == 0, "cluster failed under stray-connection chaos"
