@@ -2,32 +2,50 @@
 # Measurement suite to run the moment the TPU tunnel is reachable.
 # Invoked by the background tunnel watcher (tools/tunnel_watch.sh); safe
 # to run by hand. Each step is independently timeout-guarded so one
-# wedged dispatch cannot starve the rest if the tunnel drops mid-suite.
+# wedged dispatch cannot starve the rest if the tunnel drops mid-suite,
+# and each step is SKIPPED when tools/capture_status.py says its
+# evidence already exists — an interrupted window resumes, not restarts.
 set -u
 cd /root/repo
 TS=$(date -u +%Y%m%dT%H%M%SZ)
 LOG=/tmp/on_tunnel_up_$TS.log
 echo "=== tunnel-up suite $TS ===" | tee -a "$LOG"
 
+# PYTHONPATH stripped: the status check must not dial the axon relay
+# (a wedged tunnel can hang interpreter startup via sitecustomize)
+have() { PYTHONPATH= python tools/capture_status.py --have "$1"; }
+
 # Full bench: generous budgets (this is the manual/live path, not the
 # driver's capped one).
-RABIT_BENCH_DEADLINE_S=1700 RABIT_BENCH_PROBE_BUDGET_S=120 \
-  timeout 1800 python bench.py >>"$LOG" 2>&1
-echo "bench rc=$?" | tee -a "$LOG"
+if have bench_local; then
+  echo "bench: already captured, skip" | tee -a "$LOG"
+else
+  RABIT_BENCH_DEADLINE_S=1700 RABIT_BENCH_PROBE_BUDGET_S=120 \
+    timeout 1800 python bench.py >>"$LOG" 2>&1
+  echo "bench rc=$?" | tee -a "$LOG"
+fi
 
 # Kernel HW proof (fusion branches + flash fwd/bwd throughput).
-timeout 1800 python tools/kernel_hw_proof.py >>"$LOG" 2>&1
-echo "kernel_hw_proof rc=$?" | tee -a "$LOG"
+if have kernel_hw; then
+  echo "kernel_hw_proof: already captured, skip" | tee -a "$LOG"
+else
+  timeout 1800 python tools/kernel_hw_proof.py >>"$LOG" 2>&1
+  echo "kernel_hw_proof rc=$?" | tee -a "$LOG"
+fi
 
-# Histogram cost sweep (VERDICT r3 #4), if present.
-if [ -f tools/histogram_sweep.py ]; then
+# Histogram cost sweep (VERDICT r3 #4).
+if have hist_sweep; then
+  echo "histogram_sweep: already captured, skip" | tee -a "$LOG"
+else
   timeout 1800 python tools/histogram_sweep.py >>"$LOG" 2>&1
   echo "histogram_sweep rc=$?" | tee -a "$LOG"
 fi
 
 # End-to-end boosting-round bench (VERDICT r3 #7): host phase + the
 # TPU kernel phase that needs the tunnel.
-if [ -f tools/boosted_bench.py ]; then
+if have boosted_tpu; then
+  echo "boosted_bench: already captured, skip" | tee -a "$LOG"
+else
   timeout 1800 python tools/boosted_bench.py >>"$LOG" 2>&1
   echo "boosted_bench rc=$?" | tee -a "$LOG"
 fi
@@ -35,7 +53,9 @@ fi
 # Wire-quantization encode/decode overhead on-chip (the per-hop compute
 # a multi-chip ring pays to move fewer bytes; host phase already
 # captured in WIRE_BENCH_* artifacts).
-if [ -f tools/wire_bench.py ]; then
+if have wire_tpu; then
+  echo "wire_bench(tpu): already captured, skip" | tee -a "$LOG"
+else
   timeout 900 python tools/wire_bench.py --tpu-only >>"$LOG" 2>&1
   echo "wire_bench(tpu) rc=$?" | tee -a "$LOG"
 fi
@@ -43,12 +63,22 @@ fi
 # Flagship training on-chip: default attention vs the Pallas flash path
 # (fwd + fused bwd) — decides whether RABIT_FLASH_ATTN should become
 # the flagship default.
-timeout 1200 python tools/flagship_hw_proof.py >>"$LOG" 2>&1
-echo "flagship(default) rc=$?" | tee -a "$LOG"
-RABIT_FLASH_ATTN=1 timeout 1200 python tools/flagship_hw_proof.py >>"$LOG" 2>&1
-echo "flagship(flash) rc=$?" | tee -a "$LOG"
+if have flagship_default; then
+  echo "flagship(default): already captured, skip" | tee -a "$LOG"
+else
+  timeout 1200 python tools/flagship_hw_proof.py >>"$LOG" 2>&1
+  echo "flagship(default) rc=$?" | tee -a "$LOG"
+fi
+if have flagship_flash; then
+  echo "flagship(flash): already captured, skip" | tee -a "$LOG"
+else
+  RABIT_FLASH_ATTN=1 timeout 1200 python tools/flagship_hw_proof.py >>"$LOG" 2>&1
+  echo "flagship(flash) rc=$?" | tee -a "$LOG"
+fi
 
-echo "=== suite done; artifacts: ===" | tee -a "$LOG"
+echo "=== suite done; outstanding: ===" | tee -a "$LOG"
+PYTHONPATH= python tools/capture_status.py | tee -a "$LOG"
+echo "=== artifacts: ===" | tee -a "$LOG"
 ls -t BENCH_LOCAL_*.json KERNEL_HW_*.json HIST_SWEEP_*.json \
   BOOSTED_BENCH_*.json FLAGSHIP_HW_*.json WIRE_BENCH_*.json \
   2>/dev/null | head -12 | tee -a "$LOG"

@@ -1,11 +1,29 @@
 #!/bin/bash
 # Background tunnel watcher: probe the TPU tunnel in throwaway processes
 # (a wedged tunnel hangs any dispatch, so never probe in a process you
-# need); the moment a probe succeeds, run tools/on_tunnel_up.sh once and
-# exit. Log: /tmp/tunnel_watch.log
+# need); whenever a probe succeeds, run tools/on_tunnel_up.sh, then
+# KEEP WATCHING until tools/capture_status.py reports the queued
+# evidence set complete (a window that closes mid-suite must re-arm
+# the watcher, not end it). Log: /tmp/tunnel_watch.log
 LOG=/tmp/tunnel_watch.log
+MAX_STALLED_PASSES=4
+stalled=0
+prev_gaps=999
 echo "watcher start $(date -u +%H:%M:%S)" >>"$LOG"
 while true; do
+  status_out=$(PYTHONPATH= python /root/repo/tools/capture_status.py 2>>"$LOG")
+  status_rc=$?
+  [ -n "$status_out" ] && echo "$status_out" >>"$LOG"
+  if [ "$status_rc" -eq 0 ]; then
+    echo "evidence complete at $(date -u +%H:%M:%S); watcher exits" >>"$LOG"
+    exit 0
+  elif [ "$status_rc" -ne 1 ]; then
+    # a crashed status check must NOT read as "complete" OR spin hot
+    echo "capture_status crashed rc=$status_rc; sleeping 300s" >>"$LOG"
+    sleep 300
+    continue
+  fi
+  gaps=$(printf '%s\n' "$status_out" | grep -c '^MISSING')
   timeout 100 python -c "
 import time, jax, jax.numpy as jnp, numpy as np
 assert jax.default_backend() == 'tpu', jax.default_backend()
@@ -13,11 +31,27 @@ np.asarray((jnp.ones((8,)) * float(time.time() % 1e4)).sum())
 print('UP')
 " >>"$LOG" 2>&1
   if [ $? -eq 0 ]; then
-    echo "tunnel UP at $(date -u +%H:%M:%S); running suite" >>"$LOG"
+    # the cap fires only on ZERO-PROGRESS passes: a pass that lands
+    # at least one new capture before the tunnel drops resets it
+    if [ "$gaps" -lt "$prev_gaps" ]; then
+      stalled=0
+    elif [ "$stalled" -ge "$MAX_STALLED_PASSES" ]; then
+      echo "$MAX_STALLED_PASSES suite passes with no new evidence; a" \
+           "step is persistently failing — watcher exits for a human" \
+           "look" >>"$LOG"
+      exit 1
+    fi
+    prev_gaps=$gaps
+    stalled=$((stalled + 1))
+    echo "tunnel UP at $(date -u +%H:%M:%S); suite pass (gaps=$gaps," \
+         "stalled=$stalled)" >>"$LOG"
     bash /root/repo/tools/on_tunnel_up.sh >>"$LOG" 2>&1
-    echo "suite finished rc=$? at $(date -u +%H:%M:%S)" >>"$LOG"
-    exit 0
+    echo "suite pass finished rc=$? at $(date -u +%H:%M:%S)" >>"$LOG"
+    # back off even on success: if evidence is still missing after a
+    # pass, the failing step needs the retry spaced out, not hammered
+    sleep 120
+  else
+    echo "probe failed $(date -u +%H:%M:%S); sleeping 300s" >>"$LOG"
+    sleep 300
   fi
-  echo "probe failed $(date -u +%H:%M:%S); sleeping 300s" >>"$LOG"
-  sleep 300
 done

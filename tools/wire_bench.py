@@ -78,6 +78,11 @@ def run_tpu(smoke: bool) -> list:
     import jax
     if smoke:
         jax.config.update("jax_platforms", "cpu")
+    elif jax.default_backend() != "tpu":
+        # never record a CPU-fallback run as device evidence (the
+        # tunnel can drop between the caller's probe and our jax init)
+        raise RuntimeError(
+            f"tpu phase needs a TPU backend, got {jax.default_backend()}")
     import jax.numpy as jnp
     from jax import lax
 
@@ -116,7 +121,7 @@ def _write(result: dict) -> None:
         "%Y%m%dT%H%M%SZ")
     path = os.path.join(REPO, f"WIRE_BENCH_{ts}.json")
     with open(path, "w") as f:
-        json.dump(result, f, indent=1)
+        json.dump(dict(result, timestamp_utc=ts), f, indent=1)
     print(f"wrote {path}")
 
 
