@@ -24,7 +24,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # Captures from before this cutoff predate the current kernel (the
 # v5e VMEM fix + narrow-side fusion, commit 3d0d4b7) — comparisons
-# like flagship default-vs-flash must not mix kernel versions.
+# like flagship default-vs-flash must not mix kernel versions. Date
+# granularity is right even though 3d0d4b7 was committed 02:29Z on the
+# cutoff day: the one earlier same-day artifact (BENCH_LOCAL 01:14Z)
+# was captured with that fix already in the working tree and landed IN
+# that commit — capture time precedes commit time, not the fix.
 FRESH = "20260731"
 
 KNOWN = ("kernel_hw", "hist_sweep", "boosted_tpu", "flagship_flash",

@@ -35,14 +35,16 @@ print('UP')
     # at least one new capture before the tunnel drops resets it
     if [ "$gaps" -lt "$prev_gaps" ]; then
       stalled=0
-    elif [ "$stalled" -ge "$MAX_STALLED_PASSES" ]; then
-      echo "$MAX_STALLED_PASSES suite passes with no new evidence; a" \
-           "step is persistently failing — watcher exits for a human" \
-           "look" >>"$LOG"
-      exit 1
+    else
+      if [ "$stalled" -ge "$MAX_STALLED_PASSES" ]; then
+        echo "$MAX_STALLED_PASSES suite passes with no new evidence; a" \
+             "step is persistently failing — watcher exits for a human" \
+             "look" >>"$LOG"
+        exit 1
+      fi
+      stalled=$((stalled + 1))
     fi
     prev_gaps=$gaps
-    stalled=$((stalled + 1))
     echo "tunnel UP at $(date -u +%H:%M:%S); suite pass (gaps=$gaps," \
          "stalled=$stalled)" >>"$LOG"
     bash /root/repo/tools/on_tunnel_up.sh >>"$LOG" 2>&1
