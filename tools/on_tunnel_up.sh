@@ -15,11 +15,21 @@ echo "=== tunnel-up suite $TS ===" | tee -a "$LOG"
 # (a wedged tunnel can hang interpreter startup via sitecustomize)
 have() { PYTHONPATH= python tools/capture_status.py --have "$1"; }
 
+# Probe before each step: when the tunnel drops mid-suite, bail out
+# instead of letting every remaining step burn its full timeout (the
+# watcher re-arms and resumes the missing steps at the next window).
+tunnel_ok() {
+  timeout 100 python tools/tpu_probe.py >>"$LOG" 2>&1 \
+    || { echo "tunnel dropped; aborting suite pass" | tee -a "$LOG"
+         exit 1; }
+}
+
 # Full bench: generous budgets (this is the manual/live path, not the
 # driver's capped one).
 if have bench_local; then
   echo "bench: already captured, skip" | tee -a "$LOG"
 else
+  tunnel_ok
   RABIT_BENCH_DEADLINE_S=1700 RABIT_BENCH_PROBE_BUDGET_S=120 \
     timeout 1800 python bench.py >>"$LOG" 2>&1
   echo "bench rc=$?" | tee -a "$LOG"
@@ -29,6 +39,7 @@ fi
 if have kernel_hw; then
   echo "kernel_hw_proof: already captured, skip" | tee -a "$LOG"
 else
+  tunnel_ok
   timeout 1800 python tools/kernel_hw_proof.py >>"$LOG" 2>&1
   echo "kernel_hw_proof rc=$?" | tee -a "$LOG"
 fi
@@ -37,6 +48,7 @@ fi
 if have hist_sweep; then
   echo "histogram_sweep: already captured, skip" | tee -a "$LOG"
 else
+  tunnel_ok
   timeout 1800 python tools/histogram_sweep.py >>"$LOG" 2>&1
   echo "histogram_sweep rc=$?" | tee -a "$LOG"
 fi
@@ -46,6 +58,7 @@ fi
 if have boosted_tpu; then
   echo "boosted_bench: already captured, skip" | tee -a "$LOG"
 else
+  tunnel_ok
   timeout 1800 python tools/boosted_bench.py >>"$LOG" 2>&1
   echo "boosted_bench rc=$?" | tee -a "$LOG"
 fi
@@ -56,6 +69,7 @@ fi
 if have wire_tpu; then
   echo "wire_bench(tpu): already captured, skip" | tee -a "$LOG"
 else
+  tunnel_ok
   timeout 900 python tools/wire_bench.py --tpu-only >>"$LOG" 2>&1
   echo "wire_bench(tpu) rc=$?" | tee -a "$LOG"
 fi
@@ -66,12 +80,14 @@ fi
 if have flagship_default; then
   echo "flagship(default): already captured, skip" | tee -a "$LOG"
 else
+  tunnel_ok
   timeout 1200 python tools/flagship_hw_proof.py >>"$LOG" 2>&1
   echo "flagship(default) rc=$?" | tee -a "$LOG"
 fi
 if have flagship_flash; then
   echo "flagship(flash): already captured, skip" | tee -a "$LOG"
 else
+  tunnel_ok
   RABIT_FLASH_ATTN=1 timeout 1200 python tools/flagship_hw_proof.py >>"$LOG" 2>&1
   echo "flagship(flash) rc=$?" | tee -a "$LOG"
 fi

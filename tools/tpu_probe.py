@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""One throwaway-process TPU tunnel probe, shared by tunnel_watch.sh
+and on_tunnel_up.sh so "tunnel up" means the same thing everywhere.
+Exit 0 = a real dispatch round-tripped on the tpu backend. Run ONLY
+under an external timeout (a wedged tunnel hangs dispatch forever).
+"""
+
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    backend = jax.default_backend()
+    if backend != "tpu":
+        print(f"probe: backend is {backend}, not tpu", file=sys.stderr)
+        sys.exit(1)
+    # salt defeats the tunnel runtime's (executable, inputs) memoization
+    val = np.asarray((jnp.ones((8,)) * float(time.time() % 1e4)).sum())
+    print(f"UP {val}")
+
+
+if __name__ == "__main__":
+    main()

@@ -24,12 +24,7 @@ while true; do
     continue
   fi
   gaps=$(printf '%s\n' "$status_out" | grep -c '^MISSING')
-  timeout 100 python -c "
-import time, jax, jax.numpy as jnp, numpy as np
-assert jax.default_backend() == 'tpu', jax.default_backend()
-np.asarray((jnp.ones((8,)) * float(time.time() % 1e4)).sum())
-print('UP')
-" >>"$LOG" 2>&1
+  timeout 100 python /root/repo/tools/tpu_probe.py >>"$LOG" 2>&1
   if [ $? -eq 0 ]; then
     # the cap fires only on ZERO-PROGRESS passes: a pass that lands
     # at least one new capture before the tunnel drops resets it
