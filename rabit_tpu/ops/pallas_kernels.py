@@ -318,6 +318,21 @@ def _flash_bwd_body(has_mask, sm_scale, *refs):
                     ((0,), (0,))).astype(dk_ref.dtype)
 
 
+def _flash_compiler_params():
+    """Mosaic params for both flash block kernels. At the ring chain's
+    block sizes (T = S = 1024, D = 128) the backward holds ~5 [T, S]
+    f32 temporaries (s, p, dp, ds, tie mask) — past the default 16 MB
+    *scoped* vmem budget on v5e, the same overflow that kept the
+    histogram kernel's fused path from compiling (see
+    _hist_compiler_params). The head grid axis writes disjoint
+    per-head blocks (parallel)."""
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.CompilerParams(
+        dimension_semantics=("parallel",),
+        vmem_limit_bytes=64 * 1024 * 1024,
+    )
+
+
 def _fused_bwd_enabled() -> bool:
     """Backward selection for flash_block: the fused Pallas kernel by
     default; ``RABIT_FLASH_BWD=recompute`` falls back to differentiating
@@ -359,6 +374,7 @@ def flash_block_bwd(q, k, v, m, l, o, mask_i8, sm_scale, cm, cl, co):
                    _out_struct((h, t, 1), jnp.float32, *ins),
                    _out_struct((h, t, 1), jnp.float32, *ins),
                    _out_struct((h, t, d), jnp.float32, *ins)],
+        compiler_params=_flash_compiler_params(),
         interpret=_interpret(),
     )(*ins)
 
@@ -420,6 +436,7 @@ def flash_block(q, k, v, m, l, o, mask, sm_scale):
         out_shape=[_out_struct((h, t, 1), jnp.float32, *ins),
                    _out_struct((h, t, 1), jnp.float32, *ins),
                    _out_struct((h, t, d), jnp.float32, *ins)],
+        compiler_params=_flash_compiler_params(),
         interpret=_interpret(),
     )
 

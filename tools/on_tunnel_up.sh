@@ -18,10 +18,12 @@ have() { PYTHONPATH= python tools/capture_status.py --have "$1"; }
 # Probe before each step: when the tunnel drops mid-suite, bail out
 # instead of letting every remaining step burn its full timeout (the
 # watcher re-arms and resumes the missing steps at the next window).
+# rc 75 (EX_TEMPFAIL) tells the watcher this pass ended on a tunnel
+# drop, not a failing step — it must not count toward the stall cap.
 tunnel_ok() {
   timeout 100 python tools/tpu_probe.py >>"$LOG" 2>&1 \
     || { echo "tunnel dropped; aborting suite pass" | tee -a "$LOG"
-         exit 1; }
+         exit 75; }
 }
 
 # Full bench: generous budgets (this is the manual/live path, not the

@@ -43,7 +43,13 @@ while true; do
     echo "tunnel UP at $(date -u +%H:%M:%S); suite pass (gaps=$gaps," \
          "stalled=$stalled)" >>"$LOG"
     bash /root/repo/tools/on_tunnel_up.sh >>"$LOG" 2>&1
-    echo "suite pass finished rc=$? at $(date -u +%H:%M:%S)" >>"$LOG"
+    suite_rc=$?
+    echo "suite pass finished rc=$suite_rc at $(date -u +%H:%M:%S)" >>"$LOG"
+    if [ "$suite_rc" -eq 75 ]; then
+      # pass aborted on a mid-suite tunnel drop (EX_TEMPFAIL): a
+      # flapping tunnel must not eat the stall budget
+      stalled=$((stalled > 0 ? stalled - 1 : 0))
+    fi
     # back off even on success: if evidence is still missing after a
     # pass, the failing step needs the retry spaced out, not hammered
     sleep 120
