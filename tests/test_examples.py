@@ -48,6 +48,18 @@ def test_py_example(ex):
                                          f"{ex}.py")]) == 0
 
 
+@pytest.mark.parametrize("wire", ["bf16", "int8"])
+def test_py_example_quantized_wire(wire):
+    # argv alone suffices: init() parses key=value args and exports
+    # RABIT_DATAPLANE_WIRE to the engine (engine/native.py _export_wire)
+    rc = launch_prog(
+        3, [sys.executable,
+            os.path.join(ROOT, "examples", "py", "quantized_wire.py"),
+            "rabit_dataplane=xla", "rabit_dataplane_minbytes=0",
+            f"rabit_dataplane_wire={wire}"], timeout=180)
+    assert rc == 0
+
+
 def test_speed_test_small():
     # perf harness runs and reports (tiny size: this is a smoke test)
     assert launch_prog(
