@@ -55,7 +55,10 @@ def main() -> None:
     for r in range(world):
         want += np.random.default_rng(7 + r).standard_normal(n)
     rel = float(np.max(np.abs(got - want)) / np.max(np.abs(want)))
-    budget = {"bf16": 2e-2 * np.sqrt(world), "int8": 5e-2}.get(wire, 1e-5)
+    # envelopes per doc/guide.md: ~2e-2 at world 8 growing ~sqrt(world);
+    # int8 keeps a flat floor for small worlds
+    budget = {"bf16": 2e-2 * max(1.0, world / 8) ** 0.5,
+              "int8": max(5e-2, 2e-2 * world ** 0.5)}.get(wire, 1e-5)
     assert rel <= budget, (wire, rel, budget)
     if wire in ("bf16", "int8"):
         # visibly quantized — proof the compressed ring path actually
