@@ -38,7 +38,8 @@ def main() -> None:
     from jax.experimental import pallas as pl
 
     from rabit_tpu.ops.pallas_kernels import (
-        _ATILE, _CHUNK, _interpret, _out_struct, _histogram_tpu_impl)
+        _ATILE, _CHUNK, _hist_compiler_params, _interpret, _out_struct,
+        _histogram_tpu_impl)
 
     smoke = os.environ.get("RABIT_SWEEP_SMOKE") == "1"
     if smoke:
@@ -87,6 +88,10 @@ def main() -> None:
             in_specs=[pl.BlockSpec((_CHUNK,), lambda j, i: (i,))],
             out_specs=pl.BlockSpec((1, atile, cdim), lambda j, i: (0, j, 0)),
             out_shape=_out_struct((1, nat * atile, cdim), jnp.float32, bins),
+            # same scoped-vmem budget as the full kernel: the two
+            # [chunk, lane] match masks + iotas alone flirt with the
+            # 16 MB default at chunk 16384 on v5e
+            compiler_params=_hist_compiler_params(),
             interpret=_interpret(),
         )(bins)
         return out.reshape(-1)[:nbins]
