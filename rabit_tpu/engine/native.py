@@ -34,9 +34,13 @@ def _find_library() -> str:
     if env:
         cands.append(env)
     here = os.path.dirname(os.path.abspath(__file__))
-    root = os.path.dirname(os.path.dirname(here))
+    pkg = os.path.dirname(here)
+    root = os.path.dirname(pkg)
     cands += [
+        # source-tree build first: a dev rebuild must not be shadowed
+        # by a stale copy inside an (editable-)installed package
         os.path.join(root, "native", "build", "librabit_tpu_core.so"),
+        os.path.join(pkg, "librabit_tpu_core.so"),  # installed package
         os.path.join(root, "librabit_tpu_core.so"),
     ]
     for c in cands:
