@@ -8,7 +8,14 @@ Run under the tracker, e.g.:
     python -m rabit_tpu.tracker.launch -n 4 python \
         examples/py/quantized_wire.py \
         rabit_dataplane=xla rabit_dataplane_minbytes=0 \
+        rabit_reduce_method=ring rabit_dataplane_wire_mincount=0 \
         rabit_dataplane_wire=bf16
+
+``rabit_reduce_method=ring`` pins the ring schedule (auto dispatch
+would send this demo-sized payload down the wire-less tree path) and
+``rabit_dataplane_wire_mincount=0`` forces the lossy-wire size gate
+open — an explicitly set gate beats the measured dispatch table, which
+is how you make quantization visible below its profitable sizes.
 
 The wire format only changes what travels BETWEEN ranks; the API and
 the replay/checkpoint contract are unchanged. Accuracy envelope

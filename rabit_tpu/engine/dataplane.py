@@ -120,15 +120,29 @@ class XlaDataPlane:
         # EQuARX-style wire quantization for ring-path float SUMs
         # (rabit_dataplane_wire = bf16 | int8): compresses only the
         # ppermute'd ICI bytes; accumulation stays full-precision and
-        # all ranks end bit-identical (the replay-buffer contract)
+        # all ranks end bit-identical (the replay-buffer contract).
+        # Validated here even though dispatch reads the env itself: a
+        # typo must not silently run uncompressed while the user
+        # believes the wire is quantized. Whether a requested wire
+        # actually engages is a per-payload-size decision
+        # (rabit_dataplane_wire_mincount / the dispatch table) made in
+        # parallel/dispatch.py.
         wire = os.environ.get("RABIT_DATAPLANE_WIRE", "")
         if wire and wire not in ("bf16", "int8"):
-            # a typo must not silently run uncompressed while the user
-            # believes the wire is quantized
             raise ValueError(
                 f"rabit_dataplane_wire must be 'bf16' or 'int8', "
                 f"got {wire!r}")
         self._wire: Optional[str] = wire or None
+        # allreduce algorithm override (rabit_reduce_method = auto |
+        # tree | ring | bidir | swing); "auto" consults the measured
+        # dispatch table per payload size
+        from ..parallel.dispatch import METHODS
+        method = os.environ.get("RABIT_REDUCE_METHOD", "") or "auto"
+        if method != "auto" and method not in METHODS:
+            raise ValueError(
+                f"rabit_reduce_method must be one of "
+                f"{('auto',) + METHODS}, got {method!r}")
+        self._method = method
         # keep the ctypes callback object alive for the C side
         self.c_callback = DATAPLANE_CB(self._invoke)
 
@@ -296,8 +310,11 @@ class XlaDataPlane:
             local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
             xs = jax.make_array_from_single_device_arrays(
                 (self._world, n), sharding, [local])
+            # wire="auto": the env-requested wire engages only at sizes
+            # where measurement says it pays (explicit per-call wire=
+            # in the collectives API still forces it)
             out = device_allreduce(xs, mesh, op, axis="proc",
-                                   wire=self._wire)
+                                   method=self._method, wire="auto")
             res = np.asarray(out.addressable_data(0)).reshape(-1)
         if res.dtype != buf.dtype:
             raise TypeError(

@@ -42,14 +42,27 @@ def _find_library() -> str:
         os.path.join(root, "native", "build", "librabit_tpu_core.so"),
         os.path.join(pkg, "librabit_tpu_core.so"),  # installed package
         os.path.join(root, "librabit_tpu_core.so"),
+        # bare name last: a `cmake --install`ed lib (CMAKE_INSTALL_PREFIX/
+        # lib, e.g. /usr/local/lib) resolves through the standard loader
+        # search (ld.so.conf / LD_LIBRARY_PATH), which only engages when
+        # the name has no path component — probed with an actual dlopen
+        # below since os.path.isfile can't see the loader's search path
+        "librabit_tpu_core.so",
     ]
-    for c in cands:
+    for c in cands[:-1]:
         if os.path.isfile(c):
             return c
+    try:
+        ctypes.CDLL(cands[-1])  # refcounted: _load()'s dlopen reuses it
+        return cands[-1]
+    except OSError:
+        pass
     raise ImportError(
         "librabit_tpu_core.so not found; build it with\n"
         "  cmake -S native -B native/build -G Ninja && "
         "ninja -C native/build\n"
+        "or put it on the loader path with\n"
+        "  cmake --install native/build && ldconfig\n"
         f"searched: {cands}")
 
 
@@ -115,9 +128,8 @@ class NativeEngine(Engine):
         self._loaded = False
         self._dataplane_kind = dataplane
         self._dataplane = None
-        self._wire_exported = False
-        self._wire_prev = None
-        self._wire_value = None
+        # env name -> (value before our first export, our exported value)
+        self._env_exports: dict = {}
 
     def _cache_key(self, site: str, size: int) -> bytes:
         """Deterministic replay key: caller site + payload size + an
@@ -134,34 +146,36 @@ class NativeEngine(Engine):
         self._key_counts[base] = n + 1
         return f"{base}@{n}".encode()
 
-    def _export_wire(self, wire: str) -> None:
+    def _export_env(self, name: str, value: str) -> None:
         """config param -> env so the data plane (and any respawned
-        process) sees one consistent wire setting; tracked so finalize
-        can undo it — an engine configured WITHOUT the param must not
+        process) sees one consistent setting; tracked so finalize can
+        undo it — an engine configured WITHOUT the param must not
         inherit a previous engine's value, while a value the user set
-        independently in the environment must survive finalize."""
-        if wire:
-            if not self._wire_exported:
+        independently in the environment must survive finalize. Used
+        for the data-plane tuning knobs (rabit_dataplane_wire,
+        rabit_dataplane_wire_mincount, rabit_reduce_method)."""
+        if value:
+            if name not in self._env_exports:
                 # first export only: a retried init must not snapshot
                 # the engine's own exported value as "the user's"
-                self._wire_prev = os.environ.get("RABIT_DATAPLANE_WIRE")
-            os.environ["RABIT_DATAPLANE_WIRE"] = wire
-            self._wire_value = wire
-            self._wire_exported = True
+                self._env_exports[name] = (os.environ.get(name), value)
+            else:
+                self._env_exports[name] = (self._env_exports[name][0],
+                                           value)
+            os.environ[name] = value
 
-    def _restore_wire(self) -> None:
-        # only touch the var if it still holds OUR export — if another
+    def _restore_env(self) -> None:
+        # only touch a var if it still holds OUR export — if another
         # owner (the public API is a per-process singleton, but engines
         # are per-thread internally) overwrote it meanwhile, it is no
         # longer ours to restore
-        if self._wire_exported:
-            if os.environ.get("RABIT_DATAPLANE_WIRE") == self._wire_value:
-                if self._wire_prev is None:
-                    os.environ.pop("RABIT_DATAPLANE_WIRE", None)
+        for name, (prev, ours) in self._env_exports.items():
+            if os.environ.get(name) == ours:
+                if prev is None:
+                    os.environ.pop(name, None)
                 else:
-                    os.environ["RABIT_DATAPLANE_WIRE"] = self._wire_prev
-            self._wire_prev = None
-            self._wire_exported = False
+                    os.environ[name] = prev
+        self._env_exports = {}
 
     def _check(self, rc: int, what: str) -> None:
         if rc != 0:
@@ -187,7 +201,12 @@ class NativeEngine(Engine):
         self._check(self._lib.RbtInit(len(argv), arr), "init")
         if kind == "xla" and self.is_distributed:
             from .dataplane import XlaDataPlane
-            self._export_wire(cfg.get("rabit_dataplane_wire", ""))
+            self._export_env("RABIT_DATAPLANE_WIRE",
+                             cfg.get("rabit_dataplane_wire", ""))
+            self._export_env("RABIT_DATAPLANE_WIRE_MINCOUNT",
+                             cfg.get("rabit_dataplane_wire_mincount", ""))
+            self._export_env("RABIT_REDUCE_METHOD",
+                             cfg.get("rabit_reduce_method", ""))
             self._dataplane = XlaDataPlane(
                 self._lib,
                 init_timeout=cfg.get_int("rabit_dataplane_init_timeout", 60))
@@ -218,7 +237,7 @@ class NativeEngine(Engine):
             # ordering between ranks is needed (see dataplane.py)
             self._dataplane.shutdown()
             self._dataplane = None
-        self._restore_wire()
+        self._restore_env()
         self._check(self._lib.RbtFinalize(), "finalize")
 
     def allreduce(self, buf: np.ndarray, op: int,

@@ -10,9 +10,13 @@ jitted XLA program whose cross-process collective rides ICI/DCN; the
 replicated result is fetched back into the caller's buffer — preserving
 the reference's in-place ``sendrecvbuf`` contract (engine.h:74-96).
 
-Ring-vs-tree dispatch by element count implements the
+Algorithm dispatch by element count generalizes the
 ``reduce_ring_mincount`` crossover (allreduce_base.h:532-534) the
-reference documents but never wires.
+reference documents but never wires: with ``rabit_reduce_method=auto``
+(the default) each payload picks among {tree, ring, bidir, swing} — and
+gates a requested quantized wire — from the measured table in
+``parallel/dispatch.py``; an explicit ``rabit_reduce_ring_mincount``
+pins the legacy two-way tree/ring split instead.
 
 Fault tolerance note: this engine is the *data plane* only. XLA
 collectives hang if a participant dies (SURVEY §7 hard parts); the robust
@@ -31,6 +35,11 @@ from ..utils.config import Config
 from ..utils.log import log_info
 
 
+def _experimental_enable_x64():
+    from jax.experimental import enable_x64
+    return enable_x64()
+
+
 class XlaEngine(Engine):
     def __init__(self) -> None:
         self._rank = 0
@@ -41,7 +50,10 @@ class XlaEngine(Engine):
         self._local: Optional[bytes] = None
         self._lazy: Optional[Callable[[], bytes]] = None
         self._version = 0
-        self._ring_mincount = 32 << 10
+        self._ring_mincount: Optional[int] = None
+        self._method = "auto"
+        self._wire: Optional[str] = None
+        self._wire_mincount = 0
         self._debug = False
 
     def init(self, args: List[str]) -> None:
@@ -69,8 +81,26 @@ class XlaEngine(Engine):
                     process_id=cfg.get_int("rabit_process_id", 0))
         self._rank = jax.process_index()
         self._world = jax.process_count()
-        self._ring_mincount = cfg.get_int(
-            "rabit_reduce_ring_mincount", 32 << 10)
+        from ..parallel import dispatch as _dispatch
+        # an explicit rabit_reduce_ring_mincount pins the legacy
+        # two-way crossover; otherwise method="auto" consults the
+        # measured dispatch table (parallel/dispatch.py)
+        mincount = cfg.get("rabit_reduce_ring_mincount")
+        self._ring_mincount = None if mincount is None else int(mincount)
+        self._method = cfg.get("rabit_reduce_method", "auto") or "auto"
+        if self._method != "auto" and self._method not in _dispatch.METHODS:
+            raise ValueError(
+                f"rabit_reduce_method must be one of "
+                f"{('auto',) + _dispatch.METHODS}, got {self._method!r}")
+        wire = cfg.get("rabit_dataplane_wire", "") or None
+        if wire is not None and wire not in ("bf16", "int8"):
+            raise ValueError(
+                f"rabit_dataplane_wire must be 'bf16' or 'int8', "
+                f"got {wire!r}")
+        self._wire = wire
+        self._wire_mincount = cfg.get_size(
+            "rabit_dataplane_wire_mincount",
+            _dispatch.WIRE_MINCOUNT_DEFAULT)
         self._debug = cfg.get_bool("rabit_debug")
         if self._world > 1:
             self._mesh = self._build_mesh()
@@ -103,19 +133,31 @@ class XlaEngine(Engine):
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..parallel.collectives import device_allreduce
         n = buf.size
-        method = "ring" if n >= self._ring_mincount else "tree"
+        method = self._method
+        if method == "auto" and self._ring_mincount is not None:
+            method = "ring" if n >= self._ring_mincount else "tree"
+        # a configured wire engages only above the size gate
+        # (rabit_dataplane_wire_mincount); below it the payload runs
+        # unquantized — wire loses wall-clock there AND costs accuracy
+        wire = self._wire if (self._wire and n >= self._wire_mincount) \
+            else None
         mesh = self._mesh
         # 64-bit payloads: without x64, device_put silently truncates
         # int64/float64 to 32 bits; scope-enable it for this reduction
-        # (jax.enable_x64 is the >=0.9 context manager).
-        ctx = jax.enable_x64(True) if buf.dtype.itemsize == 8 \
-            else contextlib.nullcontext()
+        # (jax.enable_x64 is the >=0.9 spelling; older jax has the same
+        # context manager under jax.experimental).
+        if buf.dtype.itemsize == 8:
+            ctx = (jax.enable_x64(True) if hasattr(jax, "enable_x64")
+                   else _experimental_enable_x64())
+        else:
+            ctx = contextlib.nullcontext()
         with ctx:
             sharding = NamedSharding(mesh, P("proc"))
             local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
             xs = jax.make_array_from_single_device_arrays(
                 (self._world, n), sharding, [local])
-            out = device_allreduce(xs, mesh, op, axis="proc", method=method)
+            out = device_allreduce(xs, mesh, op, axis="proc",
+                                   method=method, wire=wire)
             res = np.asarray(out.addressable_data(0)).reshape(-1)
         if res.dtype != buf.dtype:
             raise TypeError(

@@ -13,10 +13,17 @@ as ring attention.
 from .mesh import make_mesh, best_mesh_axis  # noqa: F401
 from .collectives import (  # noqa: F401
     ring_reduce_scatter, ring_all_gather, ring_allreduce,
+    bidir_ring_allreduce, swing_allreduce,
     tree_allreduce, bcast_from_root,
-    device_allreduce, device_broadcast, RING_MINCOUNT_DEFAULT,
+    device_allreduce, device_broadcast,
+    bucket_allreduce, device_allreduce_tree,
+    RING_MINCOUNT_DEFAULT, WIRE_MINCOUNT_DEFAULT,
     psum_identity_grad, ident_psum_grad,
-    shard_map, unchecked_shard_map,
+    shard_map, unchecked_shard_map, axis_size,
+)
+from .dispatch import (  # noqa: F401
+    load_table as load_dispatch_table, resolve as resolve_dispatch,
+    wire_mincount,
 )
 from .ring_attention import (  # noqa: F401
     ring_attention, ulysses_attention, sequence_parallel_attention,

@@ -13,9 +13,22 @@ re-designed for XLA/ICI:
   analogue of the reference's TCP ring, and the building block the
   sequence-parallel/ring-attention demos reuse.
 - ``bcast_from_root``     ↔ TryBroadcast (.cc:649-737) — mask + psum.
-- ``device_allreduce`` dispatches ring vs tree by element count, wiring
-  the ``reduce_ring_mincount`` crossover the reference documents but
-  never dispatches (allreduce_base.h:532-534, SURVEY §2 #3).
+- ``bidir_ring_allreduce``: two counter-rotating rings each carrying
+  half the payload — doubles link utilization on a 1-D mesh where each
+  ICI/TCP link is full-duplex.
+- ``swing_allreduce``: the Swing recursive-distance schedule
+  (arXiv:2401.09356) — log2(p) steps whose hop distances follow
+  1,1,3,5,11,… so consecutive steps never reuse a link direction;
+  power-of-two worlds only (falls back to the ring otherwise).
+- ``device_allreduce`` dispatches {tree, ring, bidir, swing} and the
+  wire per payload size from the measured table in
+  ``parallel/dispatch.py`` — the ``reduce_ring_mincount`` crossover the
+  reference documents but never dispatches (allreduce_base.h:532-534,
+  SURVEY §2 #3), generalized from one constant to a sweep artifact.
+- ``bucket_allreduce`` / ``device_allreduce_tree``: DDP-style gradient
+  bucketing — a pytree flattens into one contiguous buffer per dtype so
+  a training step issues one large dispatched collective instead of one
+  small tree-path collective per parameter leaf.
 
 All ``ring_*``/``tree_*``/``bcast_*`` functions are *per-shard* functions:
 call them inside ``shard_map`` (or any SPMD context with a named axis).
@@ -34,6 +47,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.reducers import SUM, MAX, MIN, BITOR, jax_reduce_fn
+from .dispatch import (RING_MINCOUNT_DEFAULT,  # noqa: F401  (re-export)
+                       WIRE_MINCOUNT_DEFAULT, resolve as _dispatch_resolve)
 
 try:  # jax>=0.6 exposes shard_map at top level
     from jax import shard_map as _shard_map
@@ -66,14 +81,24 @@ def unchecked_shard_map(f, **kwargs):
     kwargs.setdefault(_CHECK_KW, False)
     return _shard_map(f, **kwargs)
 
-# Reference default crossover: ring pays off above 32K elements
-# (allreduce_base.cc:35, doc/parameters.md).
-RING_MINCOUNT_DEFAULT = 32 << 10
+def axis_size(axis_name) -> int:
+    """Static size of the named mesh axis, as a Python int.
+
+    ``lax.axis_size`` where this jax has it; otherwise ``psum`` of the
+    literal 1, which jax constant-folds to the axis size without
+    emitting a collective. Every Python-level schedule below (ring step
+    counts, Swing tables) needs this as a concrete loop bound."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
 
 
-def _ring_perm(p: int):
+def _ring_perm(p: int, reverse: bool = False):
     """next-neighbor ring permutation (reference ring_next link,
-    allreduce_base.cc:433-435)."""
+    allreduce_base.cc:433-435); ``reverse`` rotates the other way (the
+    second ring of ``bidir_ring_allreduce``)."""
+    if reverse:
+        return [(i, (i - 1) % p) for i in range(p)]
     return [(i, (i + 1) % p) for i in range(p)]
 
 
@@ -124,7 +149,8 @@ def _wire_decode(enc, wire: str, shape):
 
 
 def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
-                        wire: str | None = None) -> jax.Array:
+                        wire: str | None = None,
+                        reverse: bool = False) -> jax.Array:
     """Ring reduce-scatter: every rank contributes ``x`` (length n,
     divisible by axis size p) and ends owning chunk ``rank`` (length n/p)
     fully reduced. p-1 ppermute steps, each moving n/p elements — the
@@ -133,26 +159,34 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
 
     ``wire`` compresses the ppermute'd bytes only (accumulation stays in
     the input dtype): "bf16" (~2x fewer ICI bytes, ~1e-2 rel err over a
-    ring) or "int8" (block-scaled, ~4x, SUM only)."""
+    ring) or "int8" (block-scaled, ~4x, SUM only).
+
+    ``reverse`` runs the mirror schedule around the counter-rotating
+    ring; ownership still lands on chunk == rank."""
     if x.ndim != 1:
         raise ValueError(
             f"ring_reduce_scatter takes a 1-D per-shard array, got "
             f"shape {x.shape}; flatten first")
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     if p == 1:
         return x
     wire = _normalize_wire(wire, op, x.dtype, x.shape[0] // p)
     combine = jax_reduce_fn(op)
     idx = lax.axis_index(axis_name)
     chunks = x.reshape(p, -1)
-    perm = _ring_perm(p)
+    perm = _ring_perm(p, reverse)
     # Schedule: at step s, send chunk (idx-s-1) mod p (accumulated so
     # far), receive into chunk (idx-s-2) mod p; after p-1 steps rank i
     # owns chunk i. (Offset chosen so ownership lands on chunk==rank,
-    # unlike the classic (i+1) mod p formulation.)
+    # unlike the classic (i+1) mod p formulation.) The reverse ring
+    # mirrors the offsets: send (idx+s+1), receive into (idx+s+2).
     for step in range(p - 1):
-        send_i = (idx - step - 1) % p
-        recv_i = (idx - step - 2) % p
+        if reverse:
+            send_i = (idx + step + 1) % p
+            recv_i = (idx + step + 2) % p
+        else:
+            send_i = (idx - step - 1) % p
+            recv_i = (idx - step - 2) % p
         send = lax.dynamic_index_in_dim(chunks, send_i, 0, keepdims=False)
         if wire is None:
             got = lax.ppermute(send, axis_name, perm)
@@ -167,7 +201,8 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
 
 
 def ring_all_gather(x: jax.Array, axis_name: str,
-                    wire: str | None = None) -> jax.Array:
+                    wire: str | None = None,
+                    reverse: bool = False) -> jax.Array:
     """Ring all-gather: rank i contributes chunk ``x`` (length m) and all
     ranks end with the concatenation [p*m] in rank order
     (TryAllgatherRing, allreduce_base.cc:751-815).
@@ -178,29 +213,36 @@ def ring_all_gather(x: jax.Array, axis_name: str,
     encoded bytes, so all p ranks end bit-identical — the rabit
     replay/recovery contract. (Re-encoding per hop looks lossless but
     drifts the int8 block scale by float ULPs each hop, and ranks at
-    different hop distances then disagree at the last bit.)"""
-    p = lax.axis_size(axis_name)
+    different hop distances then disagree at the last bit.)
+
+    ``reverse`` gathers around the counter-rotating ring (pairs with
+    ``ring_reduce_scatter(reverse=True)``); rank order is unchanged."""
+    p = axis_size(axis_name)
     if p == 1:
         return x
     wire = _normalize_wire(wire, SUM, x.dtype, x.shape[0])
     idx = lax.axis_index(axis_name)
-    perm = _ring_perm(p)
+    perm = _ring_perm(p, reverse)
     if wire is not None:
         enc = _wire_encode(x, wire)
         x = _wire_decode(enc, wire, x.shape).astype(x.dtype)
     out = jnp.zeros((p,) + x.shape, x.dtype)
     out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
     for step in range(p - 1):
-        if wire is None:
+        if reverse:
+            send_i = (idx + step) % p
+            recv_i = (idx + step + 1) % p
+        else:
             send_i = (idx - step) % p
             recv_i = (idx - step - 1) % p
+        if wire is None:
             send = lax.dynamic_index_in_dim(out, send_i, 0,
                                             keepdims=False)
             got = lax.ppermute(send, axis_name, perm)
         else:
             # the chunk sent at step s is exactly the one received at
-            # step s-1 (own chunk at s=0): forward its encoding verbatim
-            recv_i = (idx - step - 1) % p
+            # step s-1 (own chunk at s=0) in either direction: forward
+            # its encoding verbatim
             enc = tuple(lax.ppermute(e, axis_name, perm) for e in enc)
             got = _wire_decode(enc, wire, x.shape).astype(x.dtype)
         out = lax.dynamic_update_index_in_dim(out, got, recv_i, 0)
@@ -216,7 +258,8 @@ def _pad_to_multiple(x: jax.Array, p: int):
 
 
 def ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
-                   wire: str | None = None) -> jax.Array:
+                   wire: str | None = None,
+                   reverse: bool = False) -> jax.Array:
     """Ring allreduce = reduce-scatter + all-gather (TryAllreduceRing,
     allreduce_base.cc:930-949). Handles lengths not divisible by p by
     zero-padding (zero is the identity for sum/bitor; for max/min the
@@ -232,7 +275,7 @@ def ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
             f"ring_allreduce takes a 1-D per-shard array, got shape "
             f"{x.shape}; flatten first (the chunking math silently "
             "misreduces higher-rank inputs)")
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     if p == 1:
         return x
     wire = _normalize_wire(wire, op, x.dtype)  # eligibility; pad below
@@ -241,9 +284,178 @@ def ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     # silently degrading real-world sizes to bf16
     mult = p * _INT8_BLOCK if wire == "int8" else p
     xp, n = _pad_to_multiple(x, mult)
-    mine = ring_reduce_scatter(xp, axis_name, op, wire=wire)
-    full = ring_all_gather(mine, axis_name, wire=wire)
+    mine = ring_reduce_scatter(xp, axis_name, op, wire=wire,
+                               reverse=reverse)
+    full = ring_all_gather(mine, axis_name, wire=wire, reverse=reverse)
     return full[:n]
+
+
+def bidir_ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
+                         wire: str | None = None) -> jax.Array:
+    """Bidirectional ring allreduce: the payload splits in half and the
+    two halves run counter-rotating rings (forward and reverse ppermute
+    schedules) that XLA overlaps — on a 1-D mesh whose links are
+    full-duplex this doubles utilized link bandwidth, halving the
+    per-step wire time of a single ring (each direction moves n/2p per
+    hop instead of n/p).
+
+    Same contract as :func:`ring_allreduce` (1-D per-shard input,
+    ``wire`` on float SUM). Payloads too small to split (< 2p elements)
+    run a single forward ring — at that size the split only adds
+    latency."""
+    if x.ndim != 1:
+        raise ValueError(
+            f"bidir_ring_allreduce takes a 1-D per-shard array, got "
+            f"shape {x.shape}; flatten first")
+    p = axis_size(axis_name)
+    n = x.shape[0]
+    if p == 1:
+        return x
+    if n < 2 * p:
+        return ring_allreduce(x, axis_name, op, wire=wire)
+    half = n - n // 2
+    lo = ring_allreduce(x[:half], axis_name, op, wire=wire)
+    hi = ring_allreduce(x[half:], axis_name, op, wire=wire, reverse=True)
+    return jnp.concatenate([lo, hi])
+
+
+@functools.lru_cache(maxsize=None)
+def _swing_tables(p: int):
+    """Static Swing schedule for a power-of-two world (arXiv:2401.09356).
+
+    Peer of rank i at step s is ``(i ± rho(s)) mod p`` (+ for even
+    ranks, − for odd) with ``rho(s) = (1-(-2)^(s+1))/3`` — the
+    1,-1,3,-5,11,… distance sequence whose property is that any two
+    ranks meet (directly or transitively) in log2(p) steps while
+    consecutive steps land on maximally distant ring neighbors.
+
+    Returns ``(peers, send_idx, recv_idx)``: ``peers[s]`` is the length-p
+    partner table (an involution, asserted); ``send_idx[s]`` /
+    ``recv_idx[s]`` are ``[p, 2^(k-1-s)]`` int arrays of the chunk
+    indices rank i ships / keeps at reduce-scatter step s. They are
+    built backward from the final ownership (rank i ends owning chunk i)
+    via ``resp[s-1][i] = resp[s][i] ∪ resp[s][peer]``; the asserted
+    invariants (peer sets disjoint, sizes exactly halving, step-0 union
+    covering all p chunks) are what make the halving schedule a correct
+    reduce-scatter. The all-gather runs the same tables in reverse."""
+    if p < 2 or p & (p - 1):
+        raise ValueError(f"swing needs a power-of-two world, got {p}")
+    k = p.bit_length() - 1
+    peers = []
+    for s in range(k):
+        d = (1 - (-2) ** (s + 1)) // 3
+        row = [(i + d) % p if i % 2 == 0 else (i - d) % p
+               for i in range(p)]
+        assert all(row[row[i]] == i for i in range(p)), (p, s, row)
+        peers.append(row)
+    resp = [None] * k
+    resp[k - 1] = [frozenset((i,)) for i in range(p)]
+    for s in range(k - 1, 0, -1):
+        resp[s - 1] = [resp[s][i] | resp[s][peers[s][i]] for i in range(p)]
+    for s in range(k):
+        for i in range(p):
+            assert len(resp[s][i]) == 1 << (k - 1 - s), (p, s, i)
+            assert not (resp[s][i] & resp[s][peers[s][i]]), (p, s, i)
+    for i in range(p):
+        assert len(resp[0][i] | resp[0][peers[0][i]]) == p, (p, i)
+    send_idx = [np.array([sorted(resp[s][peers[s][i]]) for i in range(p)],
+                         dtype=np.int32) for s in range(k)]
+    recv_idx = [np.array([sorted(resp[s][i]) for i in range(p)],
+                         dtype=np.int32) for s in range(k)]
+    return peers, send_idx, recv_idx
+
+
+def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
+                    wire: str | None = None) -> jax.Array:
+    """Swing allreduce (arXiv:2401.09356): recursive distance-halving
+    reduce-scatter + the mirrored all-gather, 2·log2(p) steps total
+    against the ring's 2(p-1) — the latency sweet spot between the tree
+    and the ring for mid-size payloads. Power-of-two worlds only;
+    other sizes fall back cleanly to :func:`ring_allreduce` (same
+    result, different schedule).
+
+    Same contract as :func:`ring_allreduce`: 1-D per-shard input;
+    ``wire`` ("bf16" | "int8", float SUM only) compresses only the
+    ppermute'd bytes, accumulation stays full-precision, and the
+    all-gather forwards each chunk's encoding verbatim so all p ranks
+    end bit-identical."""
+    if x.ndim != 1:
+        raise ValueError(
+            f"swing_allreduce takes a 1-D per-shard array, got shape "
+            f"{x.shape}; flatten first")
+    p = axis_size(axis_name)
+    if p == 1:
+        return x
+    if p & (p - 1) or x.shape[0] == 0:
+        return ring_allreduce(x, axis_name, op, wire=wire)
+    wire = _normalize_wire(wire, op, x.dtype)  # eligibility; pad below
+    mult = p * _INT8_BLOCK if wire == "int8" else p
+    xp, n = _pad_to_multiple(x, mult)
+    peers, send_idx, recv_idx = _swing_tables(p)
+    k = len(peers)
+    combine = jax_reduce_fn(op)
+    idx = lax.axis_index(axis_name)
+    chunks = xp.reshape(p, -1)
+    m = chunks.shape[1]
+
+    # Reduce-scatter: at step s exchange with peers[s], shipping the
+    # accumulated chunks the peer is responsible for (send_idx[s]) and
+    # folding the received contributions into ours (recv_idx[s]). The
+    # peer ships its rows sorted by chunk index — the same order as our
+    # recv_idx rows — so received rows align without a permutation.
+    for s in range(k):
+        perm = [(i, peers[s][i]) for i in range(p)]
+        send_rows = jnp.asarray(send_idx[s])[idx]
+        recv_rows = jnp.asarray(recv_idx[s])[idx]
+        send = jnp.take(chunks, send_rows, axis=0)
+        if wire is None:
+            got = lax.ppermute(send, axis_name, perm)
+        else:
+            enc = _wire_encode(send, wire)
+            enc = tuple(lax.ppermute(e, axis_name, perm) for e in enc)
+            got = _wire_decode(enc, wire, send.shape).astype(send.dtype)
+        cur = jnp.take(chunks, recv_rows, axis=0)
+        chunks = chunks.at[recv_rows].set(combine(cur, got))
+    mine = lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+
+    # All-gather: the same schedule backward — at step s each rank has
+    # its responsibility set resp[s] complete and ships it, receiving
+    # the peer's. With a wire, each chunk is encoded ONCE by its owner
+    # and the encoded bytes travel verbatim thereafter (see
+    # ring_all_gather on why re-encoding per hop breaks the
+    # bit-identical-ranks replay contract).
+    if wire is None:
+        out = jnp.zeros((p, m), mine.dtype)
+        out = lax.dynamic_update_index_in_dim(out, mine, idx, 0)
+        for s in range(k - 1, -1, -1):
+            perm = [(i, peers[s][i]) for i in range(p)]
+            send_rows = jnp.asarray(recv_idx[s])[idx]
+            recv_rows = jnp.asarray(send_idx[s])[idx]
+            send = jnp.take(out, send_rows, axis=0)
+            got = lax.ppermute(send, axis_name, perm)
+            out = out.at[recv_rows].set(got)
+    else:
+        enc0 = _wire_encode(mine, wire)
+        store = tuple(
+            lax.dynamic_update_index_in_dim(
+                jnp.zeros((p,) + e.shape, e.dtype), e, idx, 0)
+            for e in enc0)
+        for s in range(k - 1, -1, -1):
+            perm = [(i, peers[s][i]) for i in range(p)]
+            send_rows = jnp.asarray(recv_idx[s])[idx]
+            recv_rows = jnp.asarray(send_idx[s])[idx]
+            got = tuple(
+                lax.ppermute(jnp.take(e, send_rows, axis=0),
+                             axis_name, perm) for e in store)
+            store = tuple(e.at[recv_rows].set(g)
+                          for e, g in zip(store, got))
+        if wire == "bf16":
+            out = store[0].astype(jnp.float32)
+        else:
+            q, scale = store
+            out = q.astype(jnp.float32) * scale
+        out = out.reshape(p, m).astype(mine.dtype)
+    return out.reshape(p * m)[:n]
 
 
 def tree_allreduce(x: jax.Array, axis_name: str, op: int = SUM) -> jax.Array:
@@ -333,6 +545,21 @@ def bcast_from_root(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
 # sharded across a mesh axis (one slice per device = one "rank").
 # ---------------------------------------------------------------------------
 
+# method name -> per-shard allreduce over a flat 1-D buffer
+_METHOD_FNS = {
+    "ring": ring_allreduce,
+    "bidir": bidir_ring_allreduce,
+    "swing": swing_allreduce,
+}
+
+
+def _per_shard_allreduce(flat, axis: str, op: int, method: str,
+                         wire: str | None):
+    if method == "tree":
+        return tree_allreduce(flat, axis, op)
+    return _METHOD_FNS[method](flat, axis, op, wire=wire)
+
+
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "method",
                                              "wire"))
 def _allreduce_global(xs, mesh: Mesh, axis: str, op: int, method: str,
@@ -340,16 +567,14 @@ def _allreduce_global(xs, mesh: Mesh, axis: str, op: int, method: str,
     def per_shard(x):
         x = x.reshape(x.shape[1:])  # drop the per-device leading 1
         flat = x.reshape(-1)
-        if method == "ring":
-            red = ring_allreduce(flat, axis, op, wire=wire)
-        else:
-            red = tree_allreduce(flat, axis, op)
-        return red.reshape(x.shape)
-    # ring bodies are ppermute chains — and the BitOR tree body is an
-    # all_gather + local fold — whose replicated outputs the static
-    # checker cannot infer; the psum/pmax/pmin tree path is fully checked
-    sm = (unchecked_shard_map if method == "ring" or op == BITOR
-          else shard_map)
+        return _per_shard_allreduce(flat, axis, op, method, wire).reshape(
+            x.shape)
+    # ring-family bodies are ppermute chains — and the BitOR tree body
+    # is an all_gather + local fold — whose replicated outputs the
+    # static checker cannot infer; the psum/pmax/pmin tree path is
+    # fully checked
+    sm = (shard_map if method == "tree" and op != BITOR
+          else unchecked_shard_map)
     f = sm(per_shard, mesh=mesh, in_specs=P(axis), out_specs=P())
     return f(xs)
 
@@ -357,27 +582,132 @@ def _allreduce_global(xs, mesh: Mesh, axis: str, op: int, method: str,
 def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
                      axis: Optional[str] = None,
                      method: str = "auto",
-                     wire: Optional[str] = None) -> jax.Array:
+                     wire: Optional[str] = "auto") -> jax.Array:
     """Allreduce across a mesh axis. ``xs`` has shape [p, ...] with the
     leading axis sharded over ``axis``; returns the elementwise reduction
     with shape ``xs.shape[1:]``, replicated.
 
-    ``method="auto"`` dispatches ring when the payload is at least
-    ``RING_MINCOUNT_DEFAULT`` elements — the reference documents this
-    crossover (reduce_ring_mincount=32768) but never wires it
-    (SURVEY §2 #3); here it is actually dispatched.
+    ``method="auto"`` picks among {tree, ring, bidir, swing} per payload
+    size from the committed ``COLLECTIVE_SWEEP_*`` dispatch table
+    (``parallel/dispatch.py``); without a table it reproduces the
+    reference's documented-but-never-wired crossover
+    (reduce_ring_mincount=32768, SURVEY §2 #3): tree below 32k elements,
+    ring above, plus the big-BitOR ring override.
 
-    ``wire`` ("bf16" | "int8"): EQuARX-style wire quantization on the
-    ring path (float SUM payloads only; tree/small payloads ignore it).
+    ``wire``: EQuARX-style wire quantization on the ring-family paths
+    (float SUM payloads only; the tree path ignores it). "bf16"/"int8"
+    force it on for this call; None/"none" force it off; the default
+    "auto" engages a config/env-requested wire
+    (``rabit_dataplane_wire``) only at payload sizes where measurement
+    says it pays (the table's wire column, else
+    ``rabit_dataplane_wire_mincount``).
     """
     if axis is None:
         axis = mesh.axis_names[0]
-    if method == "auto":
-        n = int(np.prod(xs.shape[1:]))
-        method = "ring" if n >= RING_MINCOUNT_DEFAULT else "tree"
-        if op == BITOR and n >= 1024:
-            method = "ring"  # tree BitOR all-gathers: only for tiny bufs
+    n = int(np.prod(xs.shape[1:]))
+    method, wire = _dispatch_resolve(n, xs.dtype, op, mesh.shape[axis],
+                                     method=method, wire=wire)
     return _allreduce_global(xs, mesh, axis, op, method, wire)
+
+
+def bucket_allreduce(tree, axis_name: str, op: int = SUM,
+                     wire: str | None = None, method: str = "ring",
+                     presum_axis: Optional[str] = None):
+    """DDP-style bucketed allreduce of a pytree, per-shard: leaves are
+    flattened and concatenated into ONE contiguous buffer per dtype,
+    each bucket runs a single collective, and the results are split back
+    into the original structure. A training step over an l-leaf
+    parameter tree thus issues one ring-family dispatch per dtype
+    instead of l small ones — the per-collective latency the reference
+    pays per tree node, paid once.
+
+    ``presum_axis`` first psums every leaf over that (model-parallel)
+    axis — the transformer's partial-gradient fold — before bucketing
+    over ``axis_name``. ``method`` is a concrete per-shard schedule
+    ("tree" | "ring" | "bidir" | "swing"; no "auto" here — per-shard
+    code has no host table access; use :func:`device_allreduce_tree`
+    for dispatched bucketing)."""
+    if method != "tree" and method not in _METHOD_FNS:
+        raise ValueError(
+            f"method must be tree|ring|bidir|swing, got {method!r}")
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if presum_axis is not None:
+        leaves = [lax.psum(leaf, presum_axis) for leaf in leaves]
+    buckets: dict = {}
+    for i, leaf in enumerate(leaves):
+        buckets.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    out = [None] * len(leaves)
+    for idxs in buckets.values():
+        flat = jnp.concatenate([leaves[i].reshape(-1) for i in idxs])
+        red = _per_shard_allreduce(flat, axis_name, op, method, wire)
+        off = 0
+        for i in idxs:
+            size = leaves[i].size
+            out[i] = red[off:off + size].reshape(leaves[i].shape)
+            off += size
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+@functools.partial(jax.jit, static_argnames=("treedef", "mesh", "axis",
+                                             "op", "spec"))
+def _allreduce_tree_global(leaves, treedef, mesh: Mesh, axis: str, op: int,
+                           spec):
+    plan = {name: (mth, w or None) for name, mth, w in spec}
+
+    def per_shard(shards):
+        shards = [x.reshape(x.shape[1:]) for x in shards]
+        buckets: dict = {}
+        for i, x in enumerate(shards):
+            buckets.setdefault(jnp.dtype(x.dtype), []).append(i)
+        out = [None] * len(shards)
+        for dt, idxs in buckets.items():
+            mth, w = plan[dt.name]
+            flat = jnp.concatenate([shards[i].reshape(-1) for i in idxs])
+            red = _per_shard_allreduce(flat, axis, op, mth, w)
+            off = 0
+            for i in idxs:
+                size = shards[i].size
+                out[i] = red[off:off + size].reshape(shards[i].shape)
+                off += size
+        return tuple(out)
+
+    methods = {mth for _, mth, _ in spec}
+    sm = (shard_map if methods == {"tree"} and op != BITOR
+          else unchecked_shard_map)
+    f = sm(per_shard, mesh=mesh, in_specs=P(axis), out_specs=P())
+    return jax.tree_util.tree_unflatten(treedef, f(tuple(leaves)))
+
+
+def device_allreduce_tree(tree, mesh: Mesh, op: int = SUM,
+                          axis: Optional[str] = None,
+                          method: str = "auto",
+                          wire: Optional[str] = "auto"):
+    """Bucketed host-level allreduce of a pytree: every leaf has shape
+    [p, ...] with the leading axis sharded over ``axis`` (the
+    :func:`device_allreduce` layout); returns the same structure with
+    each leaf reduced to ``leaf.shape[1:]``, replicated.
+
+    Leaves are bucketed into one contiguous buffer per dtype and each
+    bucket issues ONE collective, with ``method``/``wire`` resolved per
+    bucket from the dispatch table on the bucket's TOTAL element count —
+    so a tree of many small parameters reaches the bandwidth-optimal
+    ring-family regime a per-leaf dispatch never sees."""
+    if axis is None:
+        axis = mesh.axis_names[0]
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return tree
+    totals: dict = {}
+    for leaf in leaves:
+        dt = jnp.dtype(leaf.dtype)
+        totals[dt] = totals.get(dt, 0) + int(np.prod(leaf.shape[1:]))
+    spec = []
+    for dt, n in totals.items():
+        mth, w = _dispatch_resolve(n, dt, op, mesh.shape[axis],
+                                   method=method, wire=wire)
+        spec.append((dt.name, mth, w or ""))  # "" keeps the key hashable
+    return _allreduce_tree_global(tuple(leaves), treedef, mesh, axis, op,
+                                  tuple(sorted(spec)))
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "root"))

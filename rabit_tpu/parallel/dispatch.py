@@ -1,0 +1,188 @@
+"""Measured dispatch for ``device_allreduce(method="auto")``.
+
+The reference picks its allreduce algorithm from one hard-coded
+constant (``reduce_ring_mincount = 32768``, allreduce_base.cc:35).
+``tools/collective_sweep.py`` replaces the constant with data: it times
+{tree, ring, bidir, swing} x {wire none/bf16/int8} x payload sizes on
+the mesh and emits a schema-versioned ``COLLECTIVE_SWEEP_*.json`` whose
+``table`` section this module loads. With no table committed (or an
+unreadable/foreign-schema file) dispatch falls back to the conservative
+constants below — exactly the pre-table behavior.
+
+Wire quantization is LOSSY, so it is never auto-enabled: the table (or,
+without a table, the ``rabit_dataplane_wire_mincount`` size gate) only
+decides *when* a wire the user explicitly requested (per-call ``wire=``
+beats the gate; ``rabit_dataplane_wire`` config/env is gated) actually
+engages — ``WIRE_BENCH_20260730T233920Z.json`` measured quantized wire
+LOSING below ~65k floats and winning at 4.2M, so an ungated wire makes
+small reductions both slower and less accurate.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Optional, Tuple
+
+from ..utils.config import parse_size
+
+# Fallback crossover: ring pays off above 32K elements (reference
+# allreduce_base.cc:35, doc/parameters.md).
+RING_MINCOUNT_DEFAULT = 32 << 10
+
+# Fallback wire gate: quantized wire measured losing at 65k and winning
+# at 4.2M floats on the host fabric (WIRE_BENCH_20260730T233920Z.json);
+# 256K elements sits conservatively inside that band.
+WIRE_MINCOUNT_DEFAULT = 256 << 10
+
+METHODS = ("tree", "ring", "bidir", "swing")
+
+SCHEMA_PREFIX = "rabit_tpu.collective_sweep/"
+SCHEMA = SCHEMA_PREFIX + "v1"
+
+_TABLE_ENV = "RABIT_DISPATCH_TABLE"
+_WIRE_ENV = "RABIT_DATAPLANE_WIRE"
+_WIRE_MINCOUNT_ENV = "RABIT_DATAPLANE_WIRE_MINCOUNT"
+_METHOD_ENV = "RABIT_REDUCE_METHOD"
+
+
+def wire_mincount() -> int:
+    """Element-count floor below which a config/env-requested wire stays
+    off (``rabit_dataplane_wire_mincount``; size suffixes accepted)."""
+    v = os.environ.get(_WIRE_MINCOUNT_ENV)
+    return parse_size(v) if v else WIRE_MINCOUNT_DEFAULT
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+
+def _newest_sweep() -> Optional[str]:
+    """Newest committed sweep artifact (timestamped names sort)."""
+    found = sorted(glob.glob(
+        os.path.join(_repo_root(), "COLLECTIVE_SWEEP_*.json")))
+    return found[-1] if found else None
+
+
+def _valid_rows(rows) -> bool:
+    if not isinstance(rows, list) or not rows:
+        return False
+    for r in rows:
+        if not isinstance(r, dict) or r.get("method") not in METHODS:
+            return False
+        if not (r.get("max_n") is None or isinstance(r["max_n"], int)):
+            return False
+        if r.get("wire") not in (None, "bf16", "int8"):
+            return False
+    return rows[-1].get("max_n") is None  # must cover every size
+
+
+# path -> (mtime, table-or-None); a changed file re-parses, a bad file
+# is remembered as bad until it changes
+_cache: dict = {}
+
+
+def clear_cache() -> None:
+    _cache.clear()
+
+
+def load_table(path: Optional[str] = None) -> Optional[dict]:
+    """The committed dispatch table, or None (→ fallback constants).
+
+    Resolution order: explicit ``path`` arg, ``RABIT_DISPATCH_TABLE``
+    env (``none``/``off``/``0`` disables), newest
+    ``COLLECTIVE_SWEEP_*.json`` at the repo root. A missing file, a
+    schema other than exactly ``rabit_tpu.collective_sweep/v1`` (future
+    majors must not be misread), or malformed rows all yield None —
+    dispatch must degrade to the documented defaults, never crash.
+    """
+    if path is None:
+        env = os.environ.get(_TABLE_ENV)
+        if env in ("none", "off", "0"):
+            return None
+        path = env or _newest_sweep()
+    if not path:
+        return None
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        return None
+    hit = _cache.get(path)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    table = None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        if data.get("schema") == SCHEMA:
+            cand = data.get("table")
+            if (isinstance(cand, dict)
+                    and _valid_rows(cand.get("float_sum"))
+                    and _valid_rows(cand.get("other"))):
+                table = cand
+    except (OSError, ValueError):
+        table = None
+    _cache[path] = (mtime, table)
+    return table
+
+
+def _bucket(rows, n: int) -> dict:
+    for r in rows:
+        if r["max_n"] is None or n <= r["max_n"]:
+            return r
+    return rows[-1]  # unreachable for valid tables (last max_n is None)
+
+
+def resolve(n: int, dtype, op: int, axis_size: int,
+            method: str = "auto",
+            wire: Optional[str] = "auto") -> Tuple[str, Optional[str]]:
+    """Resolve ``(method, wire)`` for an ``n``-element payload.
+
+    ``method="auto"``: per-size-bucket choice from the committed table,
+    else tree below ``RING_MINCOUNT_DEFAULT`` and ring above (with the
+    big-BitOR override — the tree BitOR path all-gathers).
+
+    ``wire="auto"``: engages the ``RABIT_DATAPLANE_WIRE`` env wire (the
+    ``rabit_dataplane_wire`` config export) only where measurement says
+    it pays — the table bucket's wire field, else ``n >=
+    wire_mincount()``. An EXPLICITLY configured mincount (the env var is
+    set) beats the table's wire column: a user who pins the gate — e.g.
+    ``rabit_dataplane_wire_mincount=0`` to force quantization at demo
+    sizes — must win over recorded policy, the same precedence rule as
+    the per-call override. No env wire (or a tree method) → None.
+    Explicit ``wire="bf16"/"int8"`` is passed through untouched
+    (per-call override); ``wire="none"``/None force it off.
+    """
+    import jax.numpy as jnp
+
+    from ..ops.reducers import BITOR, SUM
+    table = load_table()
+    wire_eligible = op == SUM and jnp.issubdtype(jnp.dtype(dtype),
+                                                 jnp.floating)
+    if method == "auto":
+        if table is not None:
+            rows = table["float_sum"] if wire_eligible else table["other"]
+            method = _bucket(rows, n)["method"]
+        else:
+            method = "ring" if n >= RING_MINCOUNT_DEFAULT else "tree"
+        if op == BITOR and n >= 1024 and method == "tree":
+            method = "ring"  # tree BitOR all-gathers: tiny buffers only
+    if method not in METHODS:
+        raise ValueError(f"method must be one of {('auto',) + METHODS}, "
+                         f"got {method!r}")
+    if method == "swing" and axis_size & (axis_size - 1):
+        method = "ring"  # swing needs a power-of-two world
+    if wire == "auto":
+        env_wire = os.environ.get(_WIRE_ENV) or None
+        if env_wire is None or method == "tree" or not wire_eligible:
+            wire = None
+        elif table is not None and not os.environ.get(_WIRE_MINCOUNT_ENV):
+            wire = env_wire if _bucket(table["float_sum"], n).get("wire") \
+                else None
+        else:
+            wire = env_wire if n >= wire_mincount() else None
+    elif wire == "none":
+        wire = None
+    return method, wire

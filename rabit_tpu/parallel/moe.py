@@ -25,7 +25,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .collectives import shard_map
+from .collectives import axis_size, shard_map
 
 Params = Dict[str, jax.Array]
 
@@ -82,7 +82,7 @@ def moe_ffn(params: Params, x: jax.Array, axis_name: str,
     this rank's expert at index 0). ``aux`` is the Switch load-balancing
     loss (mean fraction-routed x mean gate mass, scaled by e²).
     """
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     n_loc, d = x.shape
     if params["router"].shape[-1] != p:
         raise ValueError(

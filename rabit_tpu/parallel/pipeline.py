@@ -27,7 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .collectives import unchecked_shard_map, _ring_perm
+from .collectives import axis_size, unchecked_shard_map, _ring_perm
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x_micro: jax.Array,
@@ -44,7 +44,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x_micro: jax.Array,
     microbatch ``t - r`` (masked out when that index is out of range —
     the pipeline bubble), then hands its activation to rank r+1.
     """
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_micro = x_micro.shape[0]
     if p == 1:

@@ -49,7 +49,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .collectives import unchecked_shard_map, _ring_perm
+from .collectives import axis_size, unchecked_shard_map, _ring_perm
 from ..ops.pallas_kernels import NEG_INF as _NEG_INF  # shared masking const
 
 
@@ -111,7 +111,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     to the next rank each step (the reference's ring_next link,
     allreduce_base.cc:433-435).
     """
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     t = q.shape[0]
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
@@ -193,7 +193,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     dense local attention over the full sequence for its H/p heads, and
     scatters back to [T_local, H, D].
     """
-    p = lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     if p == 1:
         return reference_attention(q, k, v, causal, sm_scale)
     h = q.shape[1]

@@ -29,6 +29,9 @@ ENV_VARS = [
     "RABIT_ENGINE",
     "RABIT_DATAPLANE",
     "RABIT_DATAPLANE_MINBYTES",
+    "RABIT_DATAPLANE_WIRE",
+    "RABIT_DATAPLANE_WIRE_MINCOUNT",
+    "RABIT_REDUCE_METHOD",
     "RABIT_WORLD_SIZE",
     "RABIT_RANK",
     "rabit_world_size",

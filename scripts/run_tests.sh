@@ -2,6 +2,7 @@
 # CI test runner — the role of the reference's scripts/travis_script.sh
 # + travis_runtest.sh: build everything, then run every test tier on
 # every push. Tiers mirror SURVEY §4:
+#   0. lint (ruff when installed, tools/lint.py fallback)
 #   1. native unit/self tests (single process)
 #   2. multi-process integration with fault injection (tracker respawn)
 #   3. device-mesh + model tests on the virtual CPU mesh
@@ -9,6 +10,14 @@
 # recovery/stress tiers; default runs everything)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+echo "== tier 0: lint =="
+if command -v ruff >/dev/null 2>&1; then
+  ruff check rabit_tpu tools tests examples bench.py setup.py
+else
+  # containers without ruff fall back to the stdlib-only subset
+  python tools/lint.py
+fi
 
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null

@@ -180,3 +180,86 @@ def test_quantized_wire_ignored_for_nonfloat_and_nonsum():
                            method="ring", wire="int8")
     np.testing.assert_allclose(np.asarray(out), xs_f.max(axis=0),
                                rtol=1e-6)
+
+
+# --- bidirectional ring + Swing (recursive-distance) allreduce ----------
+
+
+def _tree_reference(mesh, xs, op):
+    """Per-shard tree_allreduce on the same mesh — the parity baseline
+    for the new schedules (int results must be BIT-exact against it)."""
+    f = unchecked_shard_map(
+        lambda x: tree_allreduce(x.reshape(-1), "workers", op),
+        mesh=mesh, in_specs=P("workers"), out_specs=P())
+    return np.asarray(f(shard_over(mesh, xs)))
+
+
+@pytest.mark.parametrize("p", [2, 3, 4, 8])
+@pytest.mark.parametrize("method", ["bidir", "swing"])
+@pytest.mark.parametrize("op", [SUM, MAX, MIN, BITOR])
+def test_bidir_swing_int_bit_exact(p, method, op):
+    """Integer reductions are order-insensitive, so the new schedules
+    must match the tree path bit-for-bit at every world size — incl.
+    non-power-of-two p where swing falls back to the single ring."""
+    mesh = make_mesh(p)
+    xs = _rand(p, 357, np.uint32, seed=p)  # not divisible by any p
+    got = device_allreduce(shard_over(mesh, xs), mesh, op, method=method)
+    np.testing.assert_array_equal(np.asarray(got), _tree_reference(mesh, xs, op))
+    np.testing.assert_array_equal(np.asarray(got), _NP_OP[op](xs))
+
+
+@pytest.mark.parametrize("p", [2, 3, 4, 8])
+@pytest.mark.parametrize("method", ["bidir", "swing"])
+@pytest.mark.parametrize("op", [SUM, MAX, MIN])
+def test_bidir_swing_float(p, method, op):
+    mesh = make_mesh(p)
+    xs = _rand(p, 1000, np.float32, seed=10 + p)
+    out = device_allreduce(shard_over(mesh, xs), mesh, op, method=method)
+    np.testing.assert_allclose(np.asarray(out), _NP_OP[op](xs),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_swing_rejects_then_falls_back_nonpow2():
+    """The schedule builder itself refuses non-power-of-two worlds (its
+    distance sequence only closes for p = 2^k); the public path routes
+    those to the single ring instead of failing."""
+    from rabit_tpu.parallel.collectives import _swing_tables
+    with pytest.raises(ValueError, match="power-of-two"):
+        _swing_tables(6)
+    mesh = make_mesh(6)
+    xs = _rand(6, 500, np.float32, seed=3)
+    out = device_allreduce(shard_over(mesh, xs), mesh, SUM, method="swing")
+    np.testing.assert_allclose(np.asarray(out), xs.sum(0),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("method", ["bidir", "swing"])
+@pytest.mark.parametrize("wire,rtol", [("bf16", 2e-2), ("int8", 5e-2)])
+def test_bidir_swing_quantized_wire(method, wire, rtol):
+    """The EQuARX wire contract extends to the new schedules: error
+    inside the wire format's envelope AND every rank bit-identical
+    (encodings are forwarded verbatim in the gather phase, never
+    re-quantized). Size chosen non-chunk- and non-block-aligned."""
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(12)
+    n = 8 * 2048 + 37
+    xs = rng.standard_normal((8, n)).astype(np.float32)
+    out = device_allreduce(shard_over(mesh, xs), mesh, SUM,
+                           method=method, wire=wire)
+    want = xs.sum(axis=0)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=rtol,
+                               atol=rtol * np.abs(want).max())
+    shards = [np.asarray(out.addressable_data(i)) for i in range(8)]
+    for i in range(1, 8):
+        np.testing.assert_array_equal(shards[0], shards[i],
+                                      err_msg=f"shard {i} diverged")
+
+
+def test_bidir_tiny_payload_falls_back_to_single_ring():
+    # n < 2p can't split into two meaningful half-rings; result must
+    # still be exact via the single-ring fallback
+    mesh = make_mesh(8)
+    xs = _rand(8, 9, np.float32, seed=4)
+    out = device_allreduce(shard_over(mesh, xs), mesh, SUM, method="bidir")
+    np.testing.assert_allclose(np.asarray(out), xs.sum(0),
+                               rtol=1e-5, atol=1e-5)

@@ -2,7 +2,6 @@
 (test/cpp/allreduce_base_test.cpp:9-66: task_id, bootstrap cache flag,
 debug flag, ring mincount)."""
 
-import numpy as np
 import pytest
 
 from rabit_tpu.utils.config import Config, parse_size

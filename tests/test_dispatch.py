@@ -1,0 +1,266 @@
+"""Dispatch-table contract: loader validation, resolve() fallback
+semantics, the wire size gate (satellite of the adaptive-dispatch PR),
+and the sweep tool's CI smoke contract.
+
+These tests run without any mesh — resolve() is pure table/env logic —
+so they stay cheap enough for the quick tier.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from rabit_tpu.ops.reducers import SUM, MAX, BITOR
+from rabit_tpu.parallel import dispatch
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VALID_TABLE = {
+    "schema": dispatch.SCHEMA,
+    "table": {
+        "float_sum": [
+            {"max_n": 10000, "method": "tree", "wire": None},
+            {"max_n": 500000, "method": "bidir", "wire": None},
+            {"max_n": None, "method": "swing", "wire": "int8"},
+        ],
+        "other": [
+            {"max_n": 10000, "method": "tree", "wire": None},
+            {"max_n": None, "method": "ring", "wire": None},
+        ],
+    },
+}
+
+
+@pytest.fixture
+def no_table(monkeypatch):
+    """Isolate from the committed repo-root artifact and env."""
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", "none")
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE_MINCOUNT", raising=False)
+    dispatch.clear_cache()
+    yield
+    dispatch.clear_cache()
+
+
+@pytest.fixture
+def table_file(tmp_path, monkeypatch):
+    p = tmp_path / "COLLECTIVE_SWEEP_test.json"
+    p.write_text(json.dumps(VALID_TABLE))
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", str(p))
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE_MINCOUNT", raising=False)
+    dispatch.clear_cache()
+    yield p
+    dispatch.clear_cache()
+
+
+# ---------------------------------------------------------------- loader
+
+
+def test_load_table_valid(table_file):
+    t = dispatch.load_table()
+    assert t is not None
+    assert t["float_sum"][0]["method"] == "tree"
+
+
+def test_load_table_env_disable(monkeypatch, table_file):
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", "none")
+    dispatch.clear_cache()
+    assert dispatch.load_table() is None
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda d: d.__setitem__("schema", "rabit_tpu.collective_sweep/v2"),
+    lambda d: d.pop("table"),
+    lambda d: d["table"].pop("other"),
+    # last row must be open-ended (max_n null) to cover every size
+    lambda d: d["table"]["float_sum"][-1].__setitem__("max_n", 999),
+    lambda d: d["table"]["other"][0].__setitem__("method", "quantum"),
+    lambda d: d["table"]["float_sum"][0].__setitem__("wire", "fp4"),
+])
+def test_load_table_rejects_malformed(tmp_path, monkeypatch, mutate):
+    bad = json.loads(json.dumps(VALID_TABLE))
+    mutate(bad)
+    p = tmp_path / "bad.json"
+    p.write_text(json.dumps(bad))
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", str(p))
+    dispatch.clear_cache()
+    assert dispatch.load_table() is None
+
+
+def test_load_table_not_json(tmp_path, monkeypatch):
+    p = tmp_path / "bad.json"
+    p.write_text("{truncated")
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", str(p))
+    dispatch.clear_cache()
+    assert dispatch.load_table() is None
+
+
+def test_load_table_missing_file(monkeypatch):
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", "/nonexistent/t.json")
+    dispatch.clear_cache()
+    assert dispatch.load_table() is None
+
+
+def test_committed_artifact_loads():
+    """The repo-root artifact (if one is committed) must satisfy its own
+    loader — a commit that breaks this ships a dead table."""
+    newest = dispatch._newest_sweep()
+    if newest is None:
+        pytest.skip("no committed sweep artifact")
+    dispatch.clear_cache()
+    try:
+        assert dispatch.load_table(newest) is not None
+    finally:
+        dispatch.clear_cache()
+
+
+# ------------------------------------------------------- resolve: method
+
+
+def test_resolve_fallback_thresholds(no_table):
+    # pre-table behavior: tree below 32k elements, ring at/above
+    f32 = np.dtype(np.float32)
+    assert dispatch.resolve(100, f32, SUM, 8)[0] == "tree"
+    assert dispatch.resolve(dispatch.RING_MINCOUNT_DEFAULT - 1,
+                            f32, SUM, 8)[0] == "tree"
+    assert dispatch.resolve(dispatch.RING_MINCOUNT_DEFAULT,
+                            f32, SUM, 8)[0] == "ring"
+
+
+def test_resolve_bitor_override(no_table):
+    # tree BitOR all-gathers, so big BitOR payloads go to the ring even
+    # below the generic crossover
+    u32 = np.dtype(np.uint32)
+    assert dispatch.resolve(100, u32, BITOR, 8)[0] == "tree"
+    assert dispatch.resolve(2048, u32, BITOR, 8)[0] == "ring"
+
+
+def test_resolve_swing_nonpow2_degrades(no_table):
+    f32 = np.dtype(np.float32)
+    assert dispatch.resolve(10**6, f32, SUM, 8, method="swing")[0] == "swing"
+    assert dispatch.resolve(10**6, f32, SUM, 6, method="swing")[0] == "ring"
+
+
+def test_resolve_explicit_method_passthrough(no_table):
+    f32 = np.dtype(np.float32)
+    for m in dispatch.METHODS:
+        assert dispatch.resolve(100, f32, SUM, 8, method=m)[0] == m
+    with pytest.raises(ValueError, match="method"):
+        dispatch.resolve(100, f32, SUM, 8, method="bogus")
+
+
+def test_resolve_consults_table(table_file):
+    f32 = np.dtype(np.float32)
+    i32 = np.dtype(np.int32)
+    assert dispatch.resolve(5000, f32, SUM, 8)[0] == "tree"
+    assert dispatch.resolve(50000, f32, SUM, 8)[0] == "bidir"
+    assert dispatch.resolve(10**6, f32, SUM, 8)[0] == "swing"
+    # non-(float,SUM) payloads use the "other" section
+    assert dispatch.resolve(50000, i32, SUM, 8)[0] == "ring"
+    assert dispatch.resolve(50000, f32, MAX, 8)[0] == "ring"
+
+
+# --------------------------------------------------------- resolve: wire
+
+
+def test_wire_off_without_env(no_table):
+    f32 = np.dtype(np.float32)
+    assert dispatch.resolve(10**7, f32, SUM, 8)[1] is None
+
+
+def test_wire_env_gated_by_mincount(no_table, monkeypatch):
+    """Satellite (a): a config/env-requested wire stays OFF below the
+    size gate — small payloads run unquantized by default."""
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "int8")
+    gate = dispatch.wire_mincount()
+    assert gate == dispatch.WIRE_MINCOUNT_DEFAULT
+    f32 = np.dtype(np.float32)
+    assert dispatch.resolve(gate - 1, f32, SUM, 8)[1] is None
+    assert dispatch.resolve(gate, f32, SUM, 8)[1] == "int8"
+
+
+def test_wire_mincount_env_override(no_table, monkeypatch):
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "bf16")
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE_MINCOUNT", "1K")
+    f32 = np.dtype(np.float32)
+    assert dispatch.wire_mincount() == 1024
+    # method pinned to ring: auto would pick tree at these sizes and the
+    # wire (a ppermute-payload codec) never engages on the tree path
+    assert dispatch.resolve(1023, f32, SUM, 8, method="ring")[1] is None
+    assert dispatch.resolve(1024, f32, SUM, 8, method="ring")[1] == "bf16"
+
+
+def test_wire_explicit_percall_beats_gate(no_table, monkeypatch):
+    """Satellite (a): explicit per-call ``wire=`` overrides the gate in
+    both directions — tiny payloads CAN be quantized on request, and
+    ``wire=None`` keeps a huge payload exact even with the env set."""
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "int8")
+    f32 = np.dtype(np.float32)
+    assert dispatch.resolve(64, f32, SUM, 8, method="ring",
+                            wire="bf16")[1] == "bf16"
+    assert dispatch.resolve(10**7, f32, SUM, 8, method="ring",
+                            wire=None)[1] is None
+    assert dispatch.resolve(10**7, f32, SUM, 8, method="ring",
+                            wire="none")[1] is None
+
+
+def test_wire_table_gate(table_file, monkeypatch):
+    """With a table, the bucket's wire flag (did quantized beat exact at
+    this size?) replaces the flat mincount gate."""
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "int8")
+    f32 = np.dtype(np.float32)
+    # buckets 1+2 say wire never paid; open bucket says it did
+    assert dispatch.resolve(5000, f32, SUM, 8)[1] is None
+    assert dispatch.resolve(50000, f32, SUM, 8)[1] is None
+    assert dispatch.resolve(10**6, f32, SUM, 8)[1] == "int8"
+
+
+def test_wire_explicit_mincount_beats_table(table_file, monkeypatch):
+    """Precedence: an explicitly configured mincount wins over the
+    table's wire column in BOTH directions — 0 forces the gate open
+    where the table says wire never pays, a huge value keeps it shut
+    where the table says it does."""
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "int8")
+    f32 = np.dtype(np.float32)
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE_MINCOUNT", "0")
+    assert dispatch.resolve(5000, f32, SUM, 8, method="ring")[1] == "int8"
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE_MINCOUNT", "1G")
+    assert dispatch.resolve(10**6, f32, SUM, 8, method="ring")[1] is None
+
+
+def test_wire_never_on_tree_or_nonfloat(no_table, monkeypatch):
+    monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "bf16")
+    f32, i32 = np.dtype(np.float32), np.dtype(np.int32)
+    assert dispatch.resolve(10**7, f32, SUM, 8, method="tree")[1] is None
+    assert dispatch.resolve(10**7, i32, SUM, 8)[1] is None
+    assert dispatch.resolve(10**7, f32, MAX, 8)[1] is None
+
+
+# ------------------------------------------------------------ sweep smoke
+
+
+@pytest.mark.slow
+def test_sweep_smoke_emits_valid_artifact(tmp_path):
+    """CI contract: ``collective_sweep.py --smoke`` must run on the CPU
+    mesh and emit an artifact the dispatch loader accepts."""
+    out = tmp_path / "SWEEP_SMOKE.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("RABIT_DISPATCH_TABLE", None)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "collective_sweep.py"),
+         "--smoke", "--world", "8", "--out", str(out)],
+        capture_output=True, text=True, timeout=600, env=env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "smoke ok" in r.stdout
+    data = json.loads(out.read_text())
+    assert data["schema"] == dispatch.SCHEMA
+    assert data["smoke"] is True
+    try:
+        assert dispatch.load_table(str(out)) is not None
+    finally:
+        dispatch.clear_cache()

@@ -51,11 +51,16 @@ def test_py_example(ex):
 @pytest.mark.parametrize("wire", ["bf16", "int8"])
 def test_py_example_quantized_wire(wire):
     # argv alone suffices: init() parses key=value args and exports
-    # RABIT_DATAPLANE_WIRE to the engine (engine/native.py _export_wire)
+    # RABIT_DATAPLANE_WIRE to the engine (engine/native.py _export_env).
+    # The demo payload sits below the default wire size gate and the
+    # committed dispatch table routes it to the (wire-less) tree, so the
+    # example pins the ring schedule and forces the gate open — the
+    # documented way to make quantization visible at demo sizes
     rc = launch_prog(
         3, [sys.executable,
             os.path.join(ROOT, "examples", "py", "quantized_wire.py"),
             "rabit_dataplane=xla", "rabit_dataplane_minbytes=0",
+            "rabit_reduce_method=ring", "rabit_dataplane_wire_mincount=0",
             f"rabit_dataplane_wire={wire}"], timeout=180)
     assert rc == 0
 

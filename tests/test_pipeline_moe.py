@@ -14,7 +14,6 @@ from rabit_tpu.parallel import (
     make_moe_fn, init_moe_params, place_moe_params, moe_reference)
 from rabit_tpu.parallel.collectives import shard_map
 from rabit_tpu.parallel import moe as moe_mod
-from rabit_tpu.parallel import pipeline as pipe_mod
 
 D = 16
 

@@ -257,7 +257,6 @@ def test_coordinator_services_reaped_across_epochs():
     """Recovery epochs must not leak coordination services: after a
     schedule with several deaths, at most the newest service survives
     (plus one mid-flight) — not one per epoch (VERDICT r2 weak #5)."""
-    from tests.test_integration import run_cluster
     stats = {}
     from rabit_tpu.tracker.launch import launch
     cmd = [sys.executable,

@@ -17,19 +17,17 @@ def _engine():
     return NativeEngine.__new__(NativeEngine)  # no lib load needed
 
 
-def _fresh_wire_state(eng):
-    eng._wire_exported = False
-    eng._wire_prev = None
-    eng._wire_value = None
+def _fresh_env_state(eng):
+    eng._env_exports = {}
 
 
 def test_wire_export_restores_preexisting_env(monkeypatch):
     monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "int8")
     eng = _engine()
-    _fresh_wire_state(eng)
-    eng._export_wire("bf16")
+    _fresh_env_state(eng)
+    eng._export_env("RABIT_DATAPLANE_WIRE", "bf16")
     assert os.environ["RABIT_DATAPLANE_WIRE"] == "bf16"
-    eng._restore_wire()
+    eng._restore_env()
     # the user's independently-set value survives finalize
     assert os.environ["RABIT_DATAPLANE_WIRE"] == "int8"
 
@@ -37,10 +35,10 @@ def test_wire_export_restores_preexisting_env(monkeypatch):
 def test_wire_export_cleans_up_when_env_was_unset(monkeypatch):
     monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
     eng = _engine()
-    _fresh_wire_state(eng)
-    eng._export_wire("bf16")
+    _fresh_env_state(eng)
+    eng._export_env("RABIT_DATAPLANE_WIRE", "bf16")
     assert os.environ["RABIT_DATAPLANE_WIRE"] == "bf16"
-    eng._restore_wire()
+    eng._restore_env()
     assert "RABIT_DATAPLANE_WIRE" not in os.environ
 
 
@@ -50,19 +48,19 @@ def test_wire_double_export_keeps_original_snapshot(monkeypatch):
     engine's own first export."""
     monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
     eng = _engine()
-    _fresh_wire_state(eng)
-    eng._export_wire("bf16")
-    eng._export_wire("bf16")  # retried init
-    eng._restore_wire()
+    _fresh_env_state(eng)
+    eng._export_env("RABIT_DATAPLANE_WIRE", "bf16")
+    eng._export_env("RABIT_DATAPLANE_WIRE", "bf16")  # retried init
+    eng._restore_env()
     assert "RABIT_DATAPLANE_WIRE" not in os.environ
 
 
 def test_wire_noop_when_param_absent(monkeypatch):
     monkeypatch.setenv("RABIT_DATAPLANE_WIRE", "int8")
     eng = _engine()
-    _fresh_wire_state(eng)
-    eng._export_wire("")
-    eng._restore_wire()
+    _fresh_env_state(eng)
+    eng._export_env("RABIT_DATAPLANE_WIRE", "")
+    eng._restore_env()
     assert os.environ["RABIT_DATAPLANE_WIRE"] == "int8"
 
 
@@ -71,12 +69,28 @@ def test_wire_restore_skips_foreign_value(monkeypatch):
     must leave it alone — it is no longer ours."""
     monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
     eng = _engine()
-    _fresh_wire_state(eng)
-    eng._export_wire("bf16")
+    _fresh_env_state(eng)
+    eng._export_env("RABIT_DATAPLANE_WIRE", "bf16")
     os.environ["RABIT_DATAPLANE_WIRE"] = "int8"  # someone else's export
-    eng._restore_wire()
+    eng._restore_env()
     assert os.environ["RABIT_DATAPLANE_WIRE"] == "int8"
     del os.environ["RABIT_DATAPLANE_WIRE"]
+
+
+def test_env_export_covers_multiple_knobs(monkeypatch):
+    """The generalized export tracks each data-plane knob
+    independently: restore puts every one back to its pre-init state."""
+    monkeypatch.setenv("RABIT_REDUCE_METHOD", "ring")
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE_MINCOUNT", raising=False)
+    eng = _engine()
+    _fresh_env_state(eng)
+    eng._export_env("RABIT_REDUCE_METHOD", "swing")
+    eng._export_env("RABIT_DATAPLANE_WIRE_MINCOUNT", "65536")
+    assert os.environ["RABIT_REDUCE_METHOD"] == "swing"
+    assert os.environ["RABIT_DATAPLANE_WIRE_MINCOUNT"] == "65536"
+    eng._restore_env()
+    assert os.environ["RABIT_REDUCE_METHOD"] == "ring"
+    assert "RABIT_DATAPLANE_WIRE_MINCOUNT" not in os.environ
 
 
 def test_slope_rejects_zero_attempts():

@@ -2,11 +2,23 @@
 coordination service (the reference tracker's role, SURVEY §2.3), then
 allreduce over the cross-process device mesh.
 
-argv: <process_id> <num_processes> <coordinator_port>
+argv: <process_id> <num_processes> <coordinator_port> [mode]
+
+mode (default "base") selects the exercise:
+  base        ring + tree allreduce paths and the pickle broadcast
+  wire-bf16 / wire-int8
+              quantized-wire allreduce over the real gloo fabric with
+              the mincount gate forced open; every rank additionally
+              proves bit-identity of its result via a CRC allreduce
+  bidir / swing
+              rabit_reduce_method config plumbed end-to-end (engine ->
+              env export -> dispatch -> per-shard schedule)
+  bcast       large-array + non-zero-root broadcast variants
 """
 
 import os
 import sys
+import zlib
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -20,27 +32,73 @@ import numpy as np  # noqa: E402
 import rabit_tpu as rabit  # noqa: E402
 
 
+def _assert_ranks_identical(arr: np.ndarray, r: int) -> None:
+    """Every rank must hold byte-identical results (the replay-buffer
+    contract): allreduce the CRC both ways and require agreement."""
+    crc = np.array([zlib.crc32(np.ascontiguousarray(arr).tobytes())],
+                   np.int64)
+    hi = rabit.allreduce(crc, rabit.MAX)
+    lo = rabit.allreduce(crc, rabit.MIN)
+    assert hi[0] == lo[0] == crc[0], (r, int(crc[0]), int(hi[0]), int(lo[0]))
+
+
 def main() -> None:
     pid, nproc, port = sys.argv[1], sys.argv[2], sys.argv[3]
-    rabit.init(["rabit_engine=xla",
-                f"rabit_coordinator=127.0.0.1:{port}",
-                f"rabit_num_processes={nproc}",
-                f"rabit_process_id={pid}"])
+    mode = sys.argv[4] if len(sys.argv) > 4 else "base"
+    cfg = ["rabit_engine=xla",
+           f"rabit_coordinator=127.0.0.1:{port}",
+           f"rabit_num_processes={nproc}",
+           f"rabit_process_id={pid}"]
+    if mode.startswith("wire-"):
+        # force the size gate open: the point here is the codec over the
+        # real fabric, not the crossover policy
+        cfg += [f"rabit_dataplane_wire={mode[5:]}",
+                "rabit_dataplane_wire_mincount=0"]
+    elif mode in ("bidir", "swing"):
+        cfg += [f"rabit_reduce_method={mode}"]
+    rabit.init(cfg)
     r, w = rabit.get_rank(), rabit.get_world_size()
     assert w == int(nproc), (r, w)
 
-    # large payload -> ring (ppermute) path
-    big = rabit.allreduce(np.full(100_000, float(r + 1), np.float32),
-                          rabit.SUM)
-    assert np.all(big == sum(range(1, w + 1))), (r, big[:3])
+    if mode == "bcast":
+        # two-phase pickle broadcast: large payload, non-zero root
+        big = np.arange(200_000, dtype=np.float32) * 3.5
+        got = rabit.broadcast(big if r == 0 else None, 0)
+        assert np.array_equal(got, big), (r, got[:3])
+        root = w - 1
+        obj = rabit.broadcast({"root": root} if r == root else None, root)
+        assert obj == {"root": root}, (r, obj)
+    elif mode.startswith("wire-"):
+        rng = np.random.default_rng(13)
+        xs = rng.standard_normal(300_000).astype(np.float32)
+        got = rabit.allreduce(xs + r, rabit.SUM)
+        want = xs * w + sum(range(w))
+        rtol = 2e-2 if mode == "wire-bf16" else 5e-2
+        np.testing.assert_allclose(got, want, rtol=rtol,
+                                   atol=rtol * np.abs(want).max())
+        _assert_ranks_identical(got, r)
+    elif mode in ("bidir", "swing"):
+        big = rabit.allreduce(np.full(150_000, float(r + 1), np.float32),
+                              rabit.SUM)
+        assert np.allclose(big, sum(range(1, w + 1))), (r, big[:3])
+        small = rabit.allreduce(np.arange(64, dtype=np.int32) + r,
+                                rabit.SUM)
+        want = np.arange(64) * w + sum(range(w))
+        assert np.array_equal(small, want), (r, small[:4])
+    else:
+        # large payload -> ring (ppermute) path
+        big = rabit.allreduce(np.full(100_000, float(r + 1), np.float32),
+                              rabit.SUM)
+        assert np.all(big == sum(range(1, w + 1))), (r, big[:3])
 
-    # small payload -> tree (psum) path
-    small = rabit.allreduce(np.arange(8, dtype=np.int32) + r, rabit.MAX)
-    assert np.all(small == np.arange(8) + (w - 1)), (r, small)
+        # small payload -> tree (psum) path
+        small = rabit.allreduce(np.arange(8, dtype=np.int32) + r, rabit.MAX)
+        assert np.all(small == np.arange(8) + (w - 1)), (r, small)
 
-    # two-phase pickle broadcast
-    obj = rabit.broadcast({"from": 0, "v": [1, 2, 3]} if r == 0 else None, 0)
-    assert obj == {"from": 0, "v": [1, 2, 3]}, (r, obj)
+        # two-phase pickle broadcast
+        obj = rabit.broadcast({"from": 0, "v": [1, 2, 3]} if r == 0 else None,
+                              0)
+        assert obj == {"from": 0, "v": [1, 2, 3]}, (r, obj)
 
     print(f"rank {r}/{w} OK", flush=True)
     rabit.finalize()

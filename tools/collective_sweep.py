@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Measured crossover sweep for the collective dispatch table.
+
+Times {tree, ring, bidir, swing} x {wire none/bf16/int8} x payload
+sizes on the device mesh (virtual CPU mesh by default — the same gloo
+fabric the XLA data plane uses in tests; on a real TPU slice the same
+sweep measures ICI) and derives the per-size-bucket dispatch table that
+``device_allreduce(method="auto")`` loads (parallel/dispatch.py).
+
+Methodology is the repo's slope timing (utils/slope.py): k collectives
+chained inside ONE jitted dispatch via ``lax.fori_loop``, slope of
+T(k_big)-T(k_small) cancels the dispatch floor, salt defeats result
+memoization. Wire modes are timed only on ring-family methods (the tree
+path ignores the wire by design) and only for the float-SUM table —
+wire quantization is float-SUM-only (collectives._normalize_wire).
+
+The derived table has two sections: ``float_sum`` (wire-eligible
+payloads) and ``other`` (swept as int32 SUM — the tree path is a
+different primitive there, so its crossover differs). Each row is
+``{"max_n": int|null, "method": ..., "wire": ...}``; bucket boundaries
+are the geometric midpoints between adjacent swept sizes and the last
+row's ``max_n: null`` covers every larger payload. The ``wire`` column
+records whether (and which) quantized wire beat the unquantized one at
+that size — dispatch uses it as the gate for a user-REQUESTED wire,
+never to auto-enable lossy compression.
+
+Writes ``COLLECTIVE_SWEEP_<ts>.json`` (schema
+``rabit_tpu.collective_sweep/v1``) at the repo root, where
+``parallel/dispatch.py`` discovers the newest one.
+
+Usage: python tools/collective_sweep.py [--smoke] [--world N]
+                                        [--out PATH]
+  --smoke   CI contract check: one tiny size, noisy timing allowed,
+            still emits a schema-valid artifact (to --out if given).
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import math
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FULL_SIZES = [4096, 32768, 262144, 2097152]
+SMOKE_SIZES = [4096]
+WIRES = (None, "bf16", "int8")
+
+
+def _ensure_devices(world: int) -> None:
+    """Force a world-sized virtual device set BEFORE jax initializes
+    (XLA fixes the device count at backend init)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={world}"
+        ).strip()
+
+
+def _make_run(mesh, axis, n, dtype, op, method, wire):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from rabit_tpu.parallel.collectives import (
+        _per_shard_allreduce, unchecked_shard_map)
+    p = mesh.shape[axis]
+
+    def per_shard(x, salt, k):
+        x = x.reshape(-1)
+
+        def body(_, acc):
+            r = _per_shard_allreduce(acc + salt, axis, op, method, wire)
+            if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+                return 0.5 * r / p + 0.5 * acc
+            return jnp.clip(r // p, 0, 1 << 20) + salt
+
+        return lax.fori_loop(0, k, body, x).reshape(1, -1)
+
+    f = jax.jit(unchecked_shard_map(
+        per_shard, mesh=mesh, in_specs=(P(axis), P(), P()),
+        out_specs=P(axis)))
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        base = jnp.linspace(-1.0, 1.0, p * n, dtype=dtype)
+    else:
+        base = (jnp.arange(p * n) % 997).astype(dtype)
+    xs = jax.device_put(base.reshape(p, n),
+                        NamedSharding(mesh, P(axis)))
+    return lambda k, salt: f(xs, jnp.asarray(salt, dtype), k)
+
+
+def _check_correct(mesh, axis, method, wire, dtype, op) -> None:
+    """A broken schedule must not win a timing race: verify the method
+    against the dense reduction once per (method, wire) combination."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from rabit_tpu.parallel.collectives import device_allreduce
+    p = mesh.shape[axis]
+    n = 2048
+    rng = np.random.default_rng(11)
+    if np.issubdtype(np.dtype(dtype), np.floating):
+        xs = rng.standard_normal((p, n)).astype(dtype)
+        want = xs.sum(0)
+        tol = 5e-2 * np.abs(want).max() if wire else 1e-4
+    else:
+        xs = rng.integers(0, 1 << 16, (p, n)).astype(dtype)
+        want = xs.sum(0)
+        tol = 0
+    got = np.asarray(device_allreduce(
+        jax.device_put(xs, NamedSharding(mesh, P(axis))),
+        mesh, op, axis=axis, method=method, wire=wire))
+    np.testing.assert_allclose(got, want, atol=tol, rtol=1e-5 if not wire
+                               else 5e-2)
+
+
+def sweep(world: int, sizes, smoke: bool) -> dict:
+    import jax
+
+    from rabit_tpu.ops.reducers import SUM
+    from rabit_tpu.parallel.collectives import _swing_tables  # noqa: F401
+    from rabit_tpu.parallel.dispatch import METHODS
+    from rabit_tpu.utils.slope import slope_time
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = jax.devices()
+    if len(devs) < world:
+        raise RuntimeError(
+            f"need {world} devices, have {len(devs)} — set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={world}")
+    mesh = Mesh(np.array(devs[:world]), ("sweep",))
+    k_small, k_big = (2, 4) if smoke else (2, 8)
+    rows = []
+    for dtype, op, section in (("float32", SUM, "float_sum"),
+                               ("int32", SUM, "other")):
+        for method in METHODS:
+            wires = (WIRES if section == "float_sum" and method != "tree"
+                     else (None,))
+            for wire in wires:
+                _check_correct(mesh, "sweep", method, wire, dtype, op)
+                for n in sizes:
+                    run = _make_run(mesh, "sweep", n, dtype, op, method,
+                                    wire)
+                    s = slope_time(run, k_small, k_big,
+                                   allow_noisy=smoke)
+                    row = {"section": section, "method": method,
+                           "wire": wire, "n": n, "s_per_op": s}
+                    rows.append(row)
+                    print(json.dumps(row), flush=True)
+    return {"world": world, "backend": jax.default_backend(),
+            "k": [k_small, k_big], "rows": rows}
+
+
+def derive_table(rows, sizes) -> dict:
+    """Per-size winners -> bucket rows. ``max_n`` boundaries are the
+    geometric midpoints between adjacent swept sizes (a payload between
+    two measurements follows its nearer neighbor); the last bucket is
+    open-ended (max_n null, required by the schema)."""
+    table = {}
+    for section in ("float_sum", "other"):
+        out = []
+        for i, n in enumerate(sizes):
+            cell = {(r["method"], r["wire"]): r["s_per_op"]
+                    for r in rows
+                    if r["section"] == section and r["n"] == n}
+            best_method = min(
+                (m for (m, w) in cell if w is None),
+                key=lambda m: cell[(m, None)])
+            wire = None
+            quantized = {w: t for (m, w), t in cell.items()
+                         if m == best_method and w is not None}
+            if quantized:
+                w_best = min(quantized, key=quantized.get)
+                if quantized[w_best] < cell[(best_method, None)]:
+                    wire = w_best
+            max_n = (None if i == len(sizes) - 1 else
+                     int(math.sqrt(n * sizes[i + 1])))
+            out.append({"max_n": max_n, "method": best_method,
+                        "wire": wire})
+        table[section] = out
+    return table
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI contract check: tiny size, noisy timing ok")
+    ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default: repo root, timestamped)")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _ensure_devices(args.world)
+
+    from rabit_tpu.parallel.dispatch import SCHEMA, load_table
+
+    sizes = SMOKE_SIZES if args.smoke else FULL_SIZES
+    result = sweep(args.world, sizes, args.smoke)
+    result["schema"] = SCHEMA
+    result["table"] = derive_table(result["rows"], sizes)
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y%m%dT%H%M%SZ")
+    result["timestamp_utc"] = ts
+    if args.smoke:
+        result["smoke"] = True  # noisy timings: never commit one of these
+    path = args.out or os.path.join(REPO, f"COLLECTIVE_SWEEP_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(f"wrote {path}")
+    # the artifact must round-trip through the loader it feeds
+    assert load_table(path) is not None, "emitted table failed validation"
+    if args.smoke:
+        print("smoke ok")
+
+
+if __name__ == "__main__":
+    main()

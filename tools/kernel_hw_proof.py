@@ -95,8 +95,7 @@ def main() -> None:
             assert ok, f"histogram {precision}/{nbins} wrong on hardware"
 
     # --- flash block kernel: forward + backward (custom VJP) --------------
-    from rabit_tpu.parallel.ring_attention import (
-        _block_update, reference_attention)
+    from rabit_tpu.parallel.ring_attention import _block_update
     from rabit_tpu.ops.pallas_kernels import flash_block
     rng = np.random.default_rng(0)
     Hh, T, D = (2, 64, 32) if smoke else (8, 256, 128)

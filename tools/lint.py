@@ -1,0 +1,147 @@
+#!/usr/bin/env python
+"""Dependency-free lint tier for scripts/run_tests.sh.
+
+The reference CI runs a lint pass before building (travis: make lint —
+dmlc-core's pylint wrapper); this repo's containers ship no linter, so
+this implements the highest-signal subset with only the stdlib:
+
+- **syntax**: every file must parse (a stale merge artifact or
+  half-edited file fails here, not mid-suite).
+- **unused imports** (pyflakes F401): an import binding never referenced
+  by name — the check that catches dead dependencies and leftover
+  refactor debris. ``# noqa`` / ``# noqa: F401`` on the import line
+  exempts it (re-export blocks in ``__init__.py`` use this, same as
+  under ruff); names listed in ``__all__`` count as used.
+- **trailing whitespace** and **tabs in indentation** (W291/W191): the
+  diff-noise generators.
+
+``scripts/run_tests.sh`` prefers ``ruff check`` when installed; this is
+the fallback so the tier never silently no-ops. Exit 0 clean, 1 with
+findings (one ``path:line: code message`` per line, ruff-style).
+
+Usage: python tools/lint.py [paths...]   (default: the repo's tracked
+Python roots — rabit_tpu/ tools/ tests/ examples/ bench.py setup.py)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_ROOTS = ("rabit_tpu", "tools", "tests", "examples", "bench.py",
+                 "setup.py")
+SKIP_DIRS = {"build", "__pycache__", ".git", "native", ".eggs"}
+
+
+def iter_py_files(paths):
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(REPO, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            yield full
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in SKIP_DIRS]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+def _noqa_lines(src: str):
+    """line numbers (1-based) carrying a blanket or F401 noqa. The
+    marker can sit on any line of a multi-line import; map it to the
+    statement via the AST node's line span instead of exact match."""
+    out = set()
+    for i, line in enumerate(src.splitlines(), 1):
+        if "# noqa" in line:
+            tail = line.split("# noqa", 1)[1].strip()
+            if not tail.startswith(":") or "F401" in tail:
+                out.add(i)
+    return out
+
+
+class _Usage(ast.NodeVisitor):
+    """Names referenced anywhere in the module (Load/Del contexts plus
+    __all__ strings); the root of an attribute chain counts for
+    ``import a.b`` style bindings."""
+
+    def __init__(self):
+        self.used = set()
+
+    def visit_Name(self, node):
+        if not isinstance(node.ctx, ast.Store):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "__all__" in targets and isinstance(node.value,
+                                               (ast.List, ast.Tuple)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, str):
+                    self.used.add(elt.value)
+        self.generic_visit(node)
+
+
+def check_file(path: str):
+    issues = []
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = os.path.relpath(path, REPO)
+    for i, line in enumerate(src.splitlines(), 1):
+        body = line.rstrip("\n")
+        if body != body.rstrip():
+            issues.append((rel, i, "W291", "trailing whitespace"))
+        stripped = body.lstrip(" ")
+        if stripped.startswith("\t"):
+            issues.append((rel, i, "W191", "tab in indentation"))
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        issues.append((rel, e.lineno or 0, "E999",
+                       f"syntax error: {e.msg}"))
+        return issues
+    noqa = _noqa_lines(src)
+    usage = _Usage()
+    usage.visit(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        span = set(range(node.lineno, (node.end_lineno or node.lineno) + 1))
+        if span & noqa:
+            continue
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name.split(".")[0]
+            if bound not in usage.used:
+                shown = alias.name + (f" as {alias.asname}"
+                                      if alias.asname else "")
+                issues.append((rel, node.lineno, "F401",
+                               f"'{shown}' imported but unused"))
+    return issues
+
+
+def main() -> int:
+    paths = sys.argv[1:] or list(DEFAULT_ROOTS)
+    issues = []
+    n_files = 0
+    for path in iter_py_files(paths):
+        n_files += 1
+        issues.extend(check_file(path))
+    for rel, line, code, msg in issues:
+        print(f"{rel}:{line}: {code} {msg}")
+    if issues:
+        print(f"{len(issues)} issue(s) in {n_files} file(s)")
+        return 1
+    print(f"lint clean ({n_files} files)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,6 +8,7 @@
 LOG=/tmp/tunnel_watch.log
 MAX_STALLED_PASSES=4
 stalled=0
+skip_charge=0
 prev_gaps=999
 echo "watcher start $(date -u +%H:%M:%S)" >>"$LOG"
 while true; do
@@ -30,6 +31,13 @@ while true; do
     # at least one new capture before the tunnel drops resets it
     if [ "$gaps" -lt "$prev_gaps" ]; then
       stalled=0
+      skip_charge=0
+    elif [ "$skip_charge" -eq 1 ]; then
+      # the previous pass aborted on a mid-suite tunnel flap (rc 75):
+      # this zero-progress pass is the flap's echo, not evidence of a
+      # persistently failing step — consume the waiver instead of
+      # charging the stall budget
+      skip_charge=0
     else
       if [ "$stalled" -ge "$MAX_STALLED_PASSES" ]; then
         echo "$MAX_STALLED_PASSES suite passes with no new evidence; a" \
@@ -47,8 +55,11 @@ while true; do
     echo "suite pass finished rc=$suite_rc at $(date -u +%H:%M:%S)" >>"$LOG"
     if [ "$suite_rc" -eq 75 ]; then
       # pass aborted on a mid-suite tunnel drop (EX_TEMPFAIL): a
-      # flapping tunnel must not eat the stall budget
-      stalled=$((stalled > 0 ? stalled - 1 : 0))
+      # flapping tunnel must not eat the stall budget. Waive the NEXT
+      # iteration's increment rather than decrementing now — at
+      # stalled=0 a pre-decrement is a no-op and the flap would still
+      # consume one stall unit when the next pass charges it.
+      skip_charge=1
     fi
     # back off even on success: if evidence is still missing after a
     # pass, the failing step needs the retry spaced out, not hammered
