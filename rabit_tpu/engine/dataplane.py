@@ -54,7 +54,8 @@ from typing import Callable, Optional
 
 import numpy as np
 
-from ..ops.reducers import DTYPE_ENUM
+from .. import telemetry
+from ..ops.reducers import DTYPE_ENUM, OP_NAMES
 
 
 def _require_private_api():
@@ -302,10 +303,18 @@ class XlaDataPlane:
             return
         mesh = self._mesh
         n = buf.size
+        # span records the wire REQUEST alongside the payload; whether
+        # the codec actually engaged at this size is the dispatch
+        # counter's provenance row (resolve() inside device_allreduce)
+        sp = telemetry.span(
+            "dataplane.allreduce", nbytes=buf.nbytes,
+            op=OP_NAMES.get(op, str(op)), method=self._method,
+            wire_requested=os.environ.get("RABIT_DATAPLANE_WIRE", "")
+            or "off")
         # 64-bit payloads: without x64 device_put truncates to 32 bits
         ctx = jax.enable_x64(True) if buf.dtype.itemsize == 8 \
             else contextlib.nullcontext()
-        with ctx:
+        with sp, ctx:
             sharding = NamedSharding(mesh, P("proc"))
             local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
             xs = jax.make_array_from_single_device_arrays(

@@ -23,7 +23,9 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from .base import Engine
-from ..ops.reducers import DTYPE_ENUM
+from .. import telemetry
+from ..ops.reducers import DTYPE_ENUM, OP_NAMES
+from ..utils import log
 
 _LIB_ENV = "RABIT_TPU_CORE_LIB"
 
@@ -199,6 +201,9 @@ class NativeEngine(Engine):
             argv.append("rabit_dataplane=xla")
         arr = (ctypes.c_char_p * len(argv))(*[a.encode() for a in argv])
         self._check(self._lib.RbtInit(len(argv), arr), "init")
+        log.set_debug(cfg.get_bool("rabit_debug"))
+        log.set_identity(self.rank, self.world_size)
+        telemetry.configure(cfg)
         if kind == "xla" and self.is_distributed:
             from .dataplane import XlaDataPlane
             self._export_env("RABIT_DATAPLANE_WIRE",
@@ -237,6 +242,18 @@ class NativeEngine(Engine):
             # ordering between ranks is needed (see dataplane.py)
             self._dataplane.shutdown()
             self._dataplane = None
+        # telemetry must flush BEFORE finalize: RbtFinalize sends the
+        # tracker its shutdown command, and the tracker exits (printing
+        # the fleet table) once every rank has. Both are best-effort —
+        # a run without telemetry or tracker skips them silently.
+        if telemetry.enabled():
+            try:
+                rank, world = self.rank, self.world_size
+                telemetry.export_at_shutdown(rank, world)
+                if self.is_distributed:
+                    telemetry.ship_to_tracker(rank, world)
+            except Exception as e:  # noqa: BLE001 - never block shutdown
+                log.log_warn("telemetry flush failed: %s", e)
         self._restore_env()
         self._check(self._lib.RbtFinalize(), "finalize")
 
@@ -254,9 +271,11 @@ class NativeEngine(Engine):
             def trampoline(_arg, fn=prepare_fun):
                 fn()
             cb = _PREPARE_CB(trampoline)
-        rc = self._lib.RbtAllreduceEx(
-            buf.ctypes.data_as(ctypes.c_void_p), buf.size, dtype_enum, op,
-            cb, None, cache_key)
+        with telemetry.span("engine.allreduce", nbytes=buf.nbytes,
+                            op=OP_NAMES.get(op, str(op)), method="native"):
+            rc = self._lib.RbtAllreduceEx(
+                buf.ctypes.data_as(ctypes.c_void_p), buf.size, dtype_enum,
+                op, cb, None, cache_key)
         self._check(rc, "allreduce")
 
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:
@@ -276,9 +295,11 @@ class NativeEngine(Engine):
         if self.rank == root and n:
             payload.raw = data
         if n:
-            rc = self._lib.RbtBroadcastEx(
-                ctypes.cast(payload, ctypes.c_void_p), n, root,
-                self._cache_key(site + "/payload", n))
+            with telemetry.span("engine.broadcast", nbytes=n,
+                                method="native", root=root):
+                rc = self._lib.RbtBroadcastEx(
+                    ctypes.cast(payload, ctypes.c_void_p), n, root,
+                    self._cache_key(site + "/payload", n))
             self._check(rc, "broadcast(payload)")
         return payload.raw[:n]
 

@@ -31,8 +31,10 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from .base import Engine
+from .. import telemetry
 from ..utils.config import Config
-from ..utils.log import log_info
+from ..utils import log
+from ..utils.log import log_debug
 
 
 def _experimental_enable_x64():
@@ -102,6 +104,9 @@ class XlaEngine(Engine):
             "rabit_dataplane_wire_mincount",
             _dispatch.WIRE_MINCOUNT_DEFAULT)
         self._debug = cfg.get_bool("rabit_debug")
+        log.set_debug(self._debug)
+        log.set_identity(self._rank, self._world)
+        telemetry.configure(cfg)
         if self._world > 1:
             self._mesh = self._build_mesh()
 
@@ -118,7 +123,7 @@ class XlaEngine(Engine):
         return Mesh(np.array(devs), ("proc",))
 
     def shutdown(self) -> None:
-        pass
+        telemetry.export_at_shutdown(self._rank, self._world)
 
     # -- collectives ------------------------------------------------------
     def allreduce(self, buf: np.ndarray, op: int,
@@ -131,6 +136,7 @@ class XlaEngine(Engine):
         import contextlib
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..ops.reducers import OP_NAMES
         from ..parallel.collectives import device_allreduce
         n = buf.size
         method = self._method
@@ -142,6 +148,9 @@ class XlaEngine(Engine):
         wire = self._wire if (self._wire and n >= self._wire_mincount) \
             else None
         mesh = self._mesh
+        sp = telemetry.span("engine.allreduce", nbytes=buf.nbytes,
+                            op=OP_NAMES.get(op, str(op)), method=method,
+                            wire=wire)
         # 64-bit payloads: without x64, device_put silently truncates
         # int64/float64 to 32 bits; scope-enable it for this reduction
         # (jax.enable_x64 is the >=0.9 spelling; older jax has the same
@@ -151,7 +160,7 @@ class XlaEngine(Engine):
                    else _experimental_enable_x64())
         else:
             ctx = contextlib.nullcontext()
-        with ctx:
+        with sp, ctx:
             sharding = NamedSharding(mesh, P("proc"))
             local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
             xs = jax.make_array_from_single_device_arrays(
@@ -163,8 +172,7 @@ class XlaEngine(Engine):
             raise TypeError(
                 f"device allreduce changed dtype {buf.dtype} -> {res.dtype}")
         np.copyto(buf, res)
-        if self._debug:
-            log_info("xla allreduce n=%d op=%d method=%s", n, op, method)
+        log_debug("xla allreduce n=%d op=%d method=%s", n, op, method)
 
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:
         if self._world == 1:
@@ -183,7 +191,8 @@ class XlaEngine(Engine):
         payload = np.zeros(size, dtype=np.uint8)
         if self._rank == root:
             payload[:] = np.frombuffer(data, dtype=np.uint8)
-        self._device_bcast(payload, root)
+        with telemetry.span("engine.broadcast", nbytes=size, root=root):
+            self._device_bcast(payload, root)
         return payload.tobytes()
 
     def _device_bcast(self, buf: np.ndarray, root: int) -> None:

@@ -46,7 +46,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.reducers import SUM, MAX, MIN, BITOR, jax_reduce_fn
+from .. import telemetry
+from ..ops.reducers import SUM, MAX, MIN, BITOR, OP_NAMES, jax_reduce_fn
 from .dispatch import (RING_MINCOUNT_DEFAULT,  # noqa: F401  (re-export)
                        WIRE_MINCOUNT_DEFAULT, resolve as _dispatch_resolve)
 
@@ -555,9 +556,14 @@ _METHOD_FNS = {
 
 def _per_shard_allreduce(flat, axis: str, op: int, method: str,
                          wire: str | None):
-    if method == "tree":
-        return tree_allreduce(flat, axis, op)
-    return _METHOD_FNS[method](flat, axis, op, wire=wire)
+    # named_scope (metadata-only, zero jaxpr equations either way) makes
+    # the chosen schedule attributable in XLA profiles when telemetry is
+    # on; nullcontext when off
+    label = f"rabit_allreduce_{method}" + (f"_{wire}" if wire else "")
+    with telemetry.trace_annotation(label):
+        if method == "tree":
+            return tree_allreduce(flat, axis, op)
+        return _METHOD_FNS[method](flat, axis, op, wire=wire)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "method",
@@ -607,7 +613,16 @@ def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
     n = int(np.prod(xs.shape[1:]))
     method, wire = _dispatch_resolve(n, xs.dtype, op, mesh.shape[axis],
                                      method=method, wire=wire)
-    return _allreduce_global(xs, mesh, axis, op, method, wire)
+    sp = telemetry.span("allreduce", nbytes=n * xs.dtype.itemsize,
+                        op=OP_NAMES.get(op, str(op)), method=method,
+                        wire=wire)
+    with sp:
+        out = _allreduce_global(xs, mesh, axis, op, method, wire)
+        if sp.live:
+            # only when measuring: a span closed on dispatch would time
+            # the async enqueue, not the collective
+            out.block_until_ready()
+    return out
 
 
 def bucket_allreduce(tree, axis_name: str, op: int = SUM,
@@ -702,19 +717,31 @@ def device_allreduce_tree(tree, mesh: Mesh, op: int = SUM,
         dt = jnp.dtype(leaf.dtype)
         totals[dt] = totals.get(dt, 0) + int(np.prod(leaf.shape[1:]))
     spec = []
+    nbytes = 0
     for dt, n in totals.items():
         mth, w = _dispatch_resolve(n, dt, op, mesh.shape[axis],
                                    method=method, wire=wire)
         spec.append((dt.name, mth, w or ""))  # "" keeps the key hashable
-    return _allreduce_tree_global(tuple(leaves), treedef, mesh, axis, op,
-                                  tuple(sorted(spec)))
+        nbytes += n * dt.itemsize
+    spec = tuple(sorted(spec))
+    sp = telemetry.span(
+        "allreduce_tree", nbytes=nbytes, op=OP_NAMES.get(op, str(op)),
+        method=",".join(sorted({m for _, m, _ in spec})),
+        buckets=len(spec), leaves=len(leaves))
+    with sp:
+        out = _allreduce_tree_global(tuple(leaves), treedef, mesh, axis,
+                                     op, spec)
+        if sp.live:
+            jax.block_until_ready(out)
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "root"))
 def _broadcast_global(xs, mesh: Mesh, axis: str, root: int):
     def per_shard(x):
         x = x.reshape(x.shape[1:])
-        return bcast_from_root(x, axis, root)
+        with telemetry.trace_annotation("rabit_broadcast"):
+            return bcast_from_root(x, axis, root)
     return shard_map(per_shard, mesh=mesh, in_specs=P(axis), out_specs=P())(xs)
 
 
@@ -724,7 +751,14 @@ def device_broadcast(xs: jax.Array, mesh: Mesh, root: int = 0,
     shape ``xs.shape[1:]`` replicated."""
     if axis is None:
         axis = mesh.axis_names[0]
-    return _broadcast_global(xs, mesh, axis, root)
+    n = int(np.prod(xs.shape[1:]))
+    sp = telemetry.span("broadcast", nbytes=n * xs.dtype.itemsize,
+                        method="psum_mask", root=root)
+    with sp:
+        out = _broadcast_global(xs, mesh, axis, root)
+        if sp.live:
+            out.block_until_ready()
+    return out
 
 
 def shard_over(mesh: Mesh, xs: np.ndarray, axis: Optional[str] = None):

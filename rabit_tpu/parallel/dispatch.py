@@ -157,7 +157,8 @@ def resolve(n: int, dtype, op: int, axis_size: int,
     """
     import jax.numpy as jnp
 
-    from ..ops.reducers import BITOR, SUM
+    from ..ops.reducers import BITOR, SUM, OP_NAMES
+    requested = method
     table = load_table()
     wire_eligible = op == SUM and jnp.issubdtype(jnp.dtype(dtype),
                                                  jnp.floating)
@@ -185,4 +186,11 @@ def resolve(n: int, dtype, op: int, axis_size: int,
             wire = env_wire if n >= wire_mincount() else None
     elif wire == "none":
         wire = None
+    from .. import telemetry
+    if telemetry.enabled():
+        provenance = ("explicit" if requested != "auto"
+                      else "table" if table is not None else "fallback")
+        telemetry.record_dispatch(
+            n, jnp.dtype(dtype).itemsize, OP_NAMES.get(op, str(op)),
+            method, wire, provenance)
     return method, wire

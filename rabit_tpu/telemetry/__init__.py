@@ -1,0 +1,160 @@
+"""Collective telemetry: per-op spans, counters, and exporters.
+
+The module-level API fronts one process-wide :class:`Recorder`:
+
+    from rabit_tpu import telemetry
+    with telemetry.span("allreduce", nbytes=nb, method="ring"):
+        ...                      # timed only when rabit_telemetry=1
+
+Off by default (``rabit_telemetry=0``). When disabled, ``span()``
+returns a shared no-op context (``live == False``) and
+``trace_annotation()`` returns ``contextlib.nullcontext()`` — zero
+jaxpr impact, asserted by ``tests/test_telemetry.py``. The package
+imports no jax at module level (the tracker imports the aggregation
+side without an accelerator stack).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Optional
+
+from .recorder import (Recorder, NULL_SPAN,  # noqa: F401  (re-export)
+                       DEFAULT_CAPACITY, size_bucket)
+from .export import (build_summary, export_summary,  # noqa: F401
+                     build_chrome_trace, export_chrome_trace,
+                     SUMMARY_KIND, TRACE_KIND)
+from .aggregate import (merge_summaries,  # noqa: F401  (re-export)
+                        format_fleet_table, FLEET_KIND)
+from .schema import (schema_id, make_header,  # noqa: F401  (re-export)
+                     matches, timestamp_utc)
+from ..utils.config import parse_size
+
+_EXPORT_ENV = "RABIT_TELEMETRY_EXPORT"
+
+_REC = Recorder()  # enabled state seeded from RABIT_TELEMETRY at import
+
+
+def enabled() -> bool:
+    return _REC.enabled
+
+
+def set_enabled(on: bool) -> None:
+    _REC.enabled = bool(on)
+
+
+def reset(capacity: Optional[int] = None,
+          enabled: Optional[bool] = None) -> None:
+    _REC.reset(capacity=capacity, enabled=enabled)
+
+
+def span(name: str, nbytes: int = 0, op=None, method=None, wire=None,
+         **attrs):
+    """Timed context for one operation — the tentpole entry point."""
+    return _REC.span(name, nbytes=nbytes, op=op, method=method, wire=wire,
+                     **attrs)
+
+
+def record_span(name: str, dur_s: float, nbytes: int = 0, **kw) -> None:
+    _REC.record_span(name, dur_s, nbytes=nbytes, **kw)
+
+
+def record_dispatch(n: int, itemsize: int, op: str, method: str,
+                    wire: Optional[str], provenance: str) -> None:
+    """One ``dispatch.resolve()`` outcome: which schedule/wire an
+    auto-resolution picked and whether the choice came from the
+    measured table, the fallback constants, or an explicit request."""
+    _REC.count("dispatch", nbytes=n * itemsize, op=op, method=method,
+               wire=wire, provenance=provenance)
+
+
+def snapshot() -> dict:
+    return _REC.snapshot()
+
+
+def stats() -> dict:
+    """Recorder occupancy counters (tests and doctors)."""
+    return {"enabled": _REC.enabled, "capacity": _REC.capacity,
+            "recorded": _REC.recorded, "dropped": _REC.dropped}
+
+
+def configure(cfg) -> bool:
+    """Apply engine config (``rabit_telemetry``,
+    ``rabit_telemetry_buffer``) at init; returns the enabled state.
+    Only keys actually present change anything, so an engine without
+    telemetry params leaves a test-enabled recorder alone."""
+    if cfg is None:
+        return _REC.enabled
+    if "rabit_telemetry" in cfg:
+        _REC.enabled = cfg.get_bool("rabit_telemetry")
+    cap = cfg.get("rabit_telemetry_buffer")
+    if cap:
+        _REC.reset(capacity=max(1, parse_size(cap)), enabled=_REC.enabled)
+    return _REC.enabled
+
+
+def trace_annotation(name: str):
+    """``jax.named_scope`` when telemetry is on (collectives become
+    attributable in XLA profiles), a plain ``nullcontext`` when off.
+    Either way no jaxpr equations are added — named_scope is pure
+    metadata — but the disabled path never imports or calls into jax."""
+    if not _REC.enabled:
+        return contextlib.nullcontext()
+    import jax
+    return jax.named_scope(name)
+
+
+def export_at_shutdown(rank: int = -1, world_size: int = 0) -> list:
+    """Write summary + Chrome-trace files into the directory named by
+    ``RABIT_TELEMETRY_EXPORT`` (``rabit_telemetry_export``); returns the
+    paths written ([] when disabled or unconfigured)."""
+    out_dir = os.environ.get(_EXPORT_ENV)
+    if not _REC.enabled or not out_dir:
+        return []
+    os.makedirs(out_dir, exist_ok=True)
+    tag = f"rank{rank}" if rank >= 0 else "local"
+    snap = _REC.snapshot()
+    spath = os.path.join(out_dir, f"telemetry_summary_{tag}.json")
+    tpath = os.path.join(out_dir, f"telemetry_trace_{tag}.json")
+    export_summary(snap, spath, rank=rank, world_size=world_size)
+    export_chrome_trace(snap, tpath, rank=rank)
+    return [spath, tpath]
+
+
+def ship_to_tracker(rank: int = -1, world_size: int = 0,
+                    timeout: float = 10.0) -> bool:
+    """Send this rank's summary to the tracker (``metrics`` wire
+    command) for fleet-wide aggregation. Uses the same env rendezvous
+    the engine used (``RABIT_TRACKER_URI``/``PORT``, ``RABIT_TASK_ID``,
+    with DMLC aliases). Must run BEFORE the engine's shutdown command —
+    the tracker exits once every rank has sent shutdown. Best-effort:
+    returns False instead of raising (a run without a tracker, or one
+    that already went away, must not fail at exit over telemetry)."""
+    if not _REC.enabled:
+        return False
+    host = (os.environ.get("RABIT_TRACKER_URI")
+            or os.environ.get("DMLC_TRACKER_URI") or "")
+    port = (os.environ.get("RABIT_TRACKER_PORT")
+            or os.environ.get("DMLC_TRACKER_PORT") or "")
+    if not host or host == "NULL" or not port:
+        return False
+    task_id = (os.environ.get("RABIT_TASK_ID")
+               or os.environ.get("DMLC_TASK_ID") or "0")
+    doc = build_summary(_REC.snapshot(), rank=rank, world_size=world_size)
+    payload = json.dumps(doc)
+    import socket
+
+    from ..tracker.tracker import MAGIC, _recv_u32, _send_str, _send_u32
+    try:
+        with socket.create_connection((host, int(port)),
+                                      timeout=timeout) as conn:
+            _send_u32(conn, MAGIC)
+            _send_str(conn, "metrics")
+            _send_str(conn, task_id)
+            _send_u32(conn, 0)  # num_attempt (informational)
+            _send_str(conn, payload)
+            return _recv_u32(conn) == 1
+    except (OSError, ValueError, ConnectionError):
+        return False

@@ -18,6 +18,9 @@ Wire protocol (binary, little-endian, length-prefixed strings):
                    of the worker's abstract-UDS listener twin; "" =
                    TCP-only)
     print:         + msg str
+    metrics:       + payload str (a rabit_tpu.telemetry_summary/v1 JSON
+                   document; the tracker stores the latest per task_id
+                   and prints the merged fleet table at end of run)
   tracker -> worker (start/recover): rank u32, world u32, epoch u32,
     coord_host str, coord_port u32 (this epoch's tracker-hosted device
     -world coordination service; empty/0 when coordinator hosting is
@@ -41,11 +44,14 @@ extra consensus round.
 
 from __future__ import annotations
 
+import json
 import socket
 import struct
 import sys
 import threading
 from typing import Dict, List, Optional, Tuple
+
+from ..telemetry.aggregate import format_fleet_table, merge_summaries
 
 MAGIC = 0x52425401
 NO_RANK = 0xFFFFFFFF
@@ -130,6 +136,8 @@ class Tracker:
         self._done = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.messages: List[str] = []
+        # task_id -> latest telemetry_summary doc shipped by that worker
+        self._metrics: Dict[str, dict] = {}
         # device-world coordinator hosting (accelerator data plane): one
         # JAX coordination service per registration epoch, living HERE —
         # a service that vanishes under a live client fatally terminates
@@ -235,6 +243,26 @@ class Tracker:
             except Exception:  # pragma: no cover - best-effort
                 pass
 
+    def merged_metrics(self) -> Optional[dict]:
+        """Fleet-merged ``telemetry_fleet`` doc from the per-rank
+        summaries shipped so far, or None when no worker shipped any."""
+        with self._lock:
+            snap = dict(self._metrics)
+        if not snap:
+            return None
+        return merge_summaries(snap)
+
+    def _print_fleet_metrics(self) -> None:
+        """End-of-run fleet table — the production replacement for
+        eyeballing per-rank TrackerPrint lines. Appended to
+        ``messages`` like a print command so launchers/tests see it."""
+        fleet = self.merged_metrics()
+        if fleet is None or not fleet.get("counters"):
+            return
+        table = format_fleet_table(fleet)
+        self.messages.append(table)
+        print(table, flush=True)
+
     def env(self, task_id: str, num_attempt: int = 0) -> Dict[str, str]:
         """Environment for a worker process."""
         return {
@@ -274,6 +302,17 @@ class Tracker:
                 print(msg, flush=True)
                 _send_u32(conn, 1)
                 conn.close()
+            elif cmd == "metrics":
+                payload = _recv_str(conn)
+                try:
+                    doc = json.loads(payload)
+                except ValueError:
+                    doc = None
+                if isinstance(doc, dict):
+                    with self._lock:
+                        self._metrics[task_id] = doc
+                _send_u32(conn, 1 if isinstance(doc, dict) else 0)
+                conn.close()
             elif cmd == "shutdown":
                 with self._lock:
                     rank = self._ranks.get(task_id)
@@ -283,6 +322,7 @@ class Tracker:
                 _send_u32(conn, 1)
                 conn.close()
                 if all_down:
+                    self._print_fleet_metrics()
                     self._done.set()
             elif cmd in ("start", "recover"):
                 host = _recv_str(conn)

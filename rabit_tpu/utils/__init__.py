@@ -1,4 +1,5 @@
 """Host-side utilities: config parsing, logging, timers, serialization."""
 
 from .config import Config, parse_size  # noqa: F401
-from .log import log_info, check, CheckError  # noqa: F401
+from .log import (log_debug, log_info, log_warn,  # noqa: F401
+                  set_debug, set_identity, check, CheckError)

@@ -32,6 +32,9 @@ ENV_VARS = [
     "RABIT_DATAPLANE_WIRE",
     "RABIT_DATAPLANE_WIRE_MINCOUNT",
     "RABIT_REDUCE_METHOD",
+    "RABIT_TELEMETRY",
+    "RABIT_TELEMETRY_BUFFER",
+    "RABIT_TELEMETRY_EXPORT",
     "RABIT_WORLD_SIZE",
     "RABIT_RANK",
     "rabit_world_size",

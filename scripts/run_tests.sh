@@ -14,10 +14,19 @@ cd "$(dirname "$0")/.."
 echo "== tier 0: lint =="
 if command -v ruff >/dev/null 2>&1; then
   ruff check rabit_tpu tools tests examples bench.py setup.py
+  # ruff can't know the repo-specific span-presence contract (T001);
+  # run the stdlib linter for that check either way
+  python tools/lint.py rabit_tpu/parallel/collectives.py \
+      rabit_tpu/engine/xla.py rabit_tpu/engine/native.py \
+      rabit_tpu/engine/dataplane.py
 else
   # containers without ruff fall back to the stdlib-only subset
   python tools/lint.py
 fi
+
+echo "== tier 0b: telemetry smoke (record -> export -> trace_report) =="
+JAX_PLATFORMS=cpu python tools/trace_report.py --smoke \
+    --dir /tmp/rabit_telemetry_smoke
 
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null

@@ -1,0 +1,36 @@
+"""Worker for the tracker-aggregation telemetry test: runs both
+collective paths with telemetry on, so finalize exports per-rank
+artifacts (``RABIT_TELEMETRY_EXPORT``) and ships the summary to the
+tracker for the end-of-run fleet table."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init([a for a in sys.argv[1:] if "=" in a] +
+               ["rabit_telemetry=1", "rabit_reduce_ring_mincount=32768"],
+               engine=os.environ.get("WORKER_ENGINE", "native"))
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+    assert rabit.is_distributed()
+
+    # tree path (small) and ring path (large) so the fleet table carries
+    # at least two distinct counter rows
+    small = rabit.allreduce(np.full(117, rank + 1, np.float32), rabit.SUM)
+    np.testing.assert_allclose(small, np.full(117, world * (world + 1) / 2))
+    big = rabit.allreduce(np.full(50000, float(rank + 1), np.float64),
+                          rabit.SUM)
+    np.testing.assert_allclose(big, np.full(50000, world * (world + 1) / 2))
+
+    rabit.finalize()  # exports artifacts + ships the metrics summary
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,12 @@ remain; exits 0 (silent) when the evidence set is complete.
 `--have X` queries a single item (0 = already captured); unknown item
 names exit 2 loudly — a fail-open typo here would silently skip a
 capture step forever.
+
+`--json` emits one machine-readable status line carrying the repo's
+shared schema header (``rabit_tpu.capture_status/v1`` — the same
+header family as BENCH_*/COLLECTIVE_SWEEP_*/telemetry artifacts), so
+the watcher parses a versioned document instead of grepping ad-hoc
+``MISSING`` lines. Exit codes are unchanged.
 """
 
 import glob
@@ -21,6 +27,9 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rabit_tpu.telemetry.schema import make_header  # noqa: E402
 
 # Captures from before this cutoff predate the current kernel (the
 # v5e VMEM fix + narrow-side fusion, commit 3d0d4b7) — comparisons
@@ -107,6 +116,12 @@ def main():
                   f"(known: {', '.join(KNOWN)})", file=sys.stderr)
             sys.exit(2)
         sys.exit(1 if item in gaps else 0)
+    if len(sys.argv) == 2 and sys.argv[1] == "--json":
+        doc = make_header("capture_status")
+        doc["complete"] = not gaps
+        doc["missing"] = dict(sorted(gaps.items()))
+        print(json.dumps(doc, sort_keys=True))
+        sys.exit(1 if gaps else 0)
     for k, why in sorted(gaps.items()):
         print(f"MISSING {k}: {why}")
     sys.exit(1 if gaps else 0)

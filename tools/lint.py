@@ -14,6 +14,11 @@ this implements the highest-signal subset with only the stdlib:
   under ruff); names listed in ``__all__`` count as used.
 - **trailing whitespace** and **tabs in indentation** (W291/W191): the
   diff-noise generators.
+- **telemetry span presence** (T001, repo-specific): every public
+  collective entry point (the SPAN_REQUIRED map) must contain a
+  ``telemetry.span(...)`` or ``telemetry.trace_annotation(...)`` call —
+  an uninstrumented hot path silently disappears from traces, fleet
+  tables, and the dispatch accounting.
 
 ``scripts/run_tests.sh`` prefers ``ruff check`` when installed; this is
 the fallback so the tier never silently no-ops. Exit 0 clean, 1 with
@@ -33,6 +38,34 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 DEFAULT_ROOTS = ("rabit_tpu", "tools", "tests", "examples", "bench.py",
                  "setup.py")
 SKIP_DIRS = {"build", "__pycache__", ".git", "native", ".eggs"}
+
+# Public collective entry points that must carry a telemetry span (or a
+# trace annotation): rel path -> required function names. Keep in sync
+# with doc/observability.md's instrumentation table.
+SPAN_REQUIRED = {
+    os.path.join("rabit_tpu", "parallel", "collectives.py"): {
+        "device_allreduce", "device_allreduce_tree", "device_broadcast",
+        "_per_shard_allreduce"},
+    os.path.join("rabit_tpu", "engine", "xla.py"): {
+        "allreduce", "broadcast"},
+    os.path.join("rabit_tpu", "engine", "native.py"): {
+        "allreduce", "broadcast"},
+    os.path.join("rabit_tpu", "engine", "dataplane.py"): {"_allreduce"},
+}
+
+_SPAN_CALL_NAMES = {"span", "trace_annotation"}
+
+
+def _has_span_call(fn_node) -> bool:
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name in _SPAN_CALL_NAMES:
+            return True
+    return False
 
 
 def iter_py_files(paths):
@@ -124,6 +157,22 @@ def check_file(path: str):
                                       if alias.asname else "")
                 issues.append((rel, node.lineno, "F401",
                                f"'{shown}' imported but unused"))
+    required = SPAN_REQUIRED.get(rel)
+    if required:
+        seen = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in required and node.name not in seen:
+                seen.add(node.name)
+                if not _has_span_call(node):
+                    issues.append((
+                        rel, node.lineno, "T001",
+                        f"collective entry point '{node.name}' has no "
+                        "telemetry span/trace_annotation"))
+        for name in sorted(required - seen):
+            issues.append((rel, 1, "T001",
+                           f"expected collective entry point '{name}' "
+                           "not found (update SPAN_REQUIRED)"))
     return issues
 
 

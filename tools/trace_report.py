@@ -1,0 +1,188 @@
+#!/usr/bin/env python
+"""Render saved telemetry artifacts into the PERF.md table format.
+
+One reporting path for sweep results and live runs: point this at any
+schema-versioned artifact the repo emits —
+
+- ``rabit_tpu.telemetry_summary/v1`` (per-rank counters,
+  ``telemetry.export_summary`` / ``RABIT_TELEMETRY_EXPORT``)
+- ``rabit_tpu.telemetry_fleet/v1``   (tracker-merged fleet stats)
+- ``rabit_tpu.telemetry_trace/v1``   (Chrome trace-event file — also
+  loadable directly in https://ui.perfetto.dev / chrome://tracing)
+- ``rabit_tpu.collective_sweep/v1``  (dispatch-table artifacts)
+
+— and it prints a GitHub-markdown table ready to paste into PERF.md.
+
+``--smoke`` is the CI contract check wired into scripts/run_tests.sh:
+record deterministic spans, export both artifacts, reload them through
+this renderer, and assert the summary's per-method byte/duration totals
+agree with the trace events. Prints ``telemetry smoke ok`` on success.
+
+Usage:
+  python tools/trace_report.py ARTIFACT.json
+  python tools/trace_report.py --smoke [--dir DIR]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from rabit_tpu.telemetry.schema import matches  # noqa: E402
+
+
+def _md_table(headers, rows):
+    lines = ["| " + " | ".join(headers) + " |",
+             "|" + "|".join("---" for _ in headers) + "|"]
+    for r in rows:
+        lines.append("| " + " | ".join(str(c) for c in r) + " |")
+    return "\n".join(lines)
+
+
+def _fmt_s(s):
+    return f"{s * 1e3:.3f} ms" if s >= 1e-3 else f"{s * 1e6:.1f} µs"
+
+
+def _fmt_bytes(n):
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.1f} MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f} KiB"
+    return f"{n} B"
+
+
+def render_counters(doc):
+    """telemetry_summary / telemetry_fleet counter rows."""
+    rows = []
+    for c in doc.get("counters", []):
+        mean = c["total_s"] / c["count"] if c["count"] else 0.0
+        rows.append((c["name"], c["op"] or "-", c["method"] or "-",
+                     c["wire"] or "-", c["bucket"],
+                     c.get("provenance", "") or "-", c["count"],
+                     _fmt_bytes(c["bytes"]), _fmt_s(c["total_s"]),
+                     _fmt_s(mean), _fmt_s(c["max_s"])))
+    head = ("name", "op", "method", "wire", "size bucket", "provenance",
+            "count", "bytes", "total", "mean", "max")
+    who = (f"fleet of {doc['num_ranks']} rank(s)"
+           if matches(doc, "telemetry_fleet")
+           else f"rank {doc.get('rank', '?')}")
+    title = (f"Telemetry summary — {who}, {doc.get('recorded', 0)} "
+             f"span(s) recorded, {doc.get('dropped', 0)} dropped "
+             f"({doc.get('timestamp_utc', '')})")
+    return title + "\n\n" + _md_table(head, rows)
+
+
+def render_trace(doc):
+    """Chrome trace: aggregate complete ("X") events per name."""
+    agg = {}
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        a = agg.setdefault(ev["name"], {"count": 0, "dur_us": 0.0,
+                                        "bytes": 0})
+        a["count"] += 1
+        a["dur_us"] += ev.get("dur", 0.0)
+        a["bytes"] += ev.get("args", {}).get("bytes", 0)
+    rows = [(name, a["count"], _fmt_bytes(a["bytes"]),
+             _fmt_s(a["dur_us"] / 1e6),
+             _fmt_s(a["dur_us"] / 1e6 / a["count"]))
+            for name, a in sorted(agg.items())]
+    title = (f"Chrome trace — {sum(a['count'] for a in agg.values())} "
+             f"event(s) ({doc.get('timestamp_utc', '')}); load the file "
+             "in https://ui.perfetto.dev for the timeline view")
+    return title + "\n\n" + _md_table(
+        ("span", "count", "bytes", "total", "mean"), rows)
+
+
+def render_sweep(doc):
+    """collective_sweep dispatch-table artifact."""
+    rows = []
+    for cls in ("float_sum", "other"):
+        for r in doc.get("table", {}).get(cls, []):
+            rows.append((cls, "open" if r["max_n"] is None else r["max_n"],
+                         r["method"], r.get("wire") or "-"))
+    title = (f"Dispatch table ({doc.get('timestamp_utc', '')}"
+             f"{', SMOKE — do not commit' if doc.get('smoke') else ''})")
+    return title + "\n\n" + _md_table(
+        ("class", "max n", "method", "wire"), rows)
+
+
+def render(doc):
+    if matches(doc, "telemetry_summary") or matches(doc, "telemetry_fleet"):
+        return render_counters(doc)
+    if matches(doc, "telemetry_trace"):
+        return render_trace(doc)
+    if doc.get("schema") == "rabit_tpu.collective_sweep/v1":
+        return render_sweep(doc)
+    raise SystemExit(f"unrecognized artifact schema {doc.get('schema')!r}")
+
+
+def smoke(out_dir):
+    """record -> export -> reload -> render round-trip, totals cross-
+    checked between the summary counters and the trace events."""
+    from rabit_tpu import telemetry
+
+    telemetry.reset(capacity=64, enabled=True)
+    spans = [("allreduce", 1e-3, 4 << 20, "sum", "ring", "bf16"),
+             ("allreduce", 2e-3, 4 << 20, "sum", "ring", "bf16"),
+             ("allreduce", 5e-4, 64 << 10, "sum", "tree", None),
+             ("broadcast", 1e-4, 1 << 10, None, "psum_mask", None)]
+    for name, dur, nb, op, method, wire in spans:
+        telemetry.record_span(name, dur, nbytes=nb, op=op, method=method,
+                              wire=wire)
+    os.makedirs(out_dir, exist_ok=True)
+    spath = os.path.join(out_dir, "telemetry_summary_smoke.json")
+    tpath = os.path.join(out_dir, "telemetry_trace_smoke.json")
+    snap = telemetry.snapshot()
+    telemetry.export_summary(snap, spath, rank=0, world_size=1)
+    telemetry.export_chrome_trace(snap, tpath, rank=0)
+    with open(spath) as f:
+        summary = json.load(f)
+    with open(tpath) as f:
+        trace = json.load(f)
+    assert matches(summary, "telemetry_summary"), summary.get("schema")
+    assert matches(trace, "telemetry_trace"), trace.get("schema")
+    # totals must agree between the two exporters (acceptance criterion)
+    want_bytes = sum(nb for _, _, nb, _, _, _ in spans)
+    want_dur = sum(d for _, d, _, _, _, _ in spans)
+    got_bytes = sum(c["bytes"] for c in summary["counters"])
+    got_dur = sum(c["total_s"] for c in summary["counters"])
+    assert got_bytes == want_bytes, (got_bytes, want_bytes)
+    assert abs(got_dur - want_dur) < 1e-9, (got_dur, want_dur)
+    evs = [e for e in trace["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == len(spans), len(evs)
+    assert sum(e["args"]["bytes"] for e in evs) == want_bytes
+    assert abs(sum(e["dur"] for e in evs) / 1e6 - want_dur) < 1e-9
+    ts = [e["ts"] for e in evs]
+    assert ts == sorted(ts), "trace ts not monotonic"
+    print(render(summary))
+    print()
+    print(render(trace))
+    print("telemetry smoke ok")
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="render telemetry/sweep artifacts as PERF.md tables")
+    ap.add_argument("artifact", nargs="?", help="path to a *.json artifact")
+    ap.add_argument("--smoke", action="store_true",
+                    help="record->export->render round-trip (CI contract)")
+    ap.add_argument("--dir", default="/tmp/rabit_telemetry_smoke",
+                    help="output dir for --smoke artifacts")
+    args = ap.parse_args()
+    if args.smoke:
+        smoke(args.dir)
+        return 0
+    if not args.artifact:
+        ap.error("need an artifact path (or --smoke)")
+    with open(args.artifact) as f:
+        doc = json.load(f)
+    print(render(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

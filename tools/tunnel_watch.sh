@@ -12,7 +12,9 @@ skip_charge=0
 prev_gaps=999
 echo "watcher start $(date -u +%H:%M:%S)" >>"$LOG"
 while true; do
-  status_out=$(PYTHONPATH= python /root/repo/tools/capture_status.py 2>>"$LOG")
+  # --json: one schema-versioned status document (rabit_tpu.
+  # capture_status/v1) instead of grepping ad-hoc MISSING lines
+  status_out=$(PYTHONPATH= python /root/repo/tools/capture_status.py --json 2>>"$LOG")
   status_rc=$?
   [ -n "$status_out" ] && echo "$status_out" >>"$LOG"
   if [ "$status_rc" -eq 0 ]; then
@@ -24,7 +26,10 @@ while true; do
     sleep 300
     continue
   fi
-  gaps=$(printf '%s\n' "$status_out" | grep -c '^MISSING')
+  # unparseable output counts as all-gaps (999), never as progress
+  gaps=$(printf '%s' "$status_out" | python -c \
+    'import json,sys; print(len(json.load(sys.stdin)["missing"]))' \
+    2>>"$LOG" || echo 999)
   timeout 100 python /root/repo/tools/tpu_probe.py >>"$LOG" 2>&1
   if [ $? -eq 0 ]; then
     # the cap fires only on ZERO-PROGRESS passes: a pass that lands
