@@ -1,0 +1,23 @@
+"""Chaos dataplane: deterministic network fault injection (ISSUE 3).
+
+An in-process TCP proxy (:mod:`rabit_tpu.chaos.proxy`) sits between
+workers and the tracker/peers and executes a declarative, seeded
+schedule (:mod:`rabit_tpu.chaos.schedule`) of delays, mid-transfer
+connection resets, partial writes, temporary partitions, and tracker
+blackouts — so every recovery path in the robust engine can be
+exercised deterministically from pytest, without real hardware faults.
+
+The launcher integrates it end to end: ``tracker.launch(...,
+chaos=spec)`` interposes one proxy in front of the tracker and one per
+worker link listener (the tracker rewrites advertised peer addresses
+through them), which is how the slow cluster tests inject a reset in
+the middle of a live allreduce. ``python -m rabit_tpu.chaos --smoke``
+is the CI round-trip (proxy up, one injected reset, retry recovery,
+clean exit) wired into ``scripts/run_tests.sh``.
+
+Stdlib-only on purpose: chaos must be loadable by the tracker/launcher
+side without jax or numpy.
+"""
+
+from .schedule import Rule, Schedule  # noqa: F401  (re-export)
+from .proxy import ChaosProxy  # noqa: F401  (re-export)

@@ -1,0 +1,200 @@
+"""Declarative, seeded fault schedules for the chaos proxy.
+
+A schedule is an ordered list of :class:`Rule`\\ s plus a seed. Every
+probabilistic draw is keyed ``(seed, rule_index, conn_index)`` through
+its own :class:`random.Random`, so two runs with the same seed and the
+same connection arrival order inject byte-identical faults — the
+determinism contract the chaos unit tests pin (a flaky chaos test is
+worse than no chaos test).
+
+Rule fields (all optional except ``kind``):
+
+========== ===========================================================
+``kind``   ``delay`` | ``reset`` | ``partial`` | ``partition`` |
+           ``blackout``
+``conn``   apply only to the nth accepted connection (0-based);
+           ``None`` = every connection
+``prob``   apply with this probability (seeded draw); default 1.0
+``max_times``  total firings across the proxy's lifetime (default
+           unlimited)
+``after_bytes``  trigger once this many payload bytes passed through
+           the connection (both directions summed); ``reset`` closes
+           both halves with RST there, ``partial`` first forwards
+           ``truncate_to`` bytes of the pending chunk
+``delay_ms``  ``delay``: added before forwarding each chunk
+``window_s``  ``(start, end)`` seconds relative to proxy start;
+           ``partition`` stalls forwarding inside the window (packets
+           neither delivered nor refused — the hung-peer shape),
+           ``blackout`` refuses new connections inside it (the
+           tracker-restart shape)
+``target``  ``"tracker"`` | ``"link"`` | ``None`` (both, the
+           default): which proxy class runs the rule. Link wiring has
+           no retry around an accepted-then-reset handshake (a peer
+           dying mid-wiring wedges ranks blocked in accept), so
+           destructive rules usually want ``"tracker"`` scoping while
+           ``"link"`` aims at established collective streams
+========== ===========================================================
+
+Specs parse from dicts, JSON strings, or ``@/path/to/file.json`` (the
+``rabit_chaos`` knob accepts the same three shapes).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import List, Optional, Sequence, Tuple
+
+KINDS = ("delay", "reset", "partial", "partition", "blackout")
+TARGETS = ("tracker", "link")
+
+
+class Rule:
+    __slots__ = ("kind", "conn", "prob", "max_times", "after_bytes",
+                 "delay_ms", "truncate_to", "window_s", "target", "fired")
+
+    def __init__(self, kind: str, conn: Optional[int] = None,
+                 prob: float = 1.0, max_times: Optional[int] = None,
+                 after_bytes: int = 0, delay_ms: float = 0.0,
+                 truncate_to: int = 0,
+                 window_s: Optional[Sequence[float]] = None,
+                 target: Optional[str] = None):
+        if kind not in KINDS:
+            raise ValueError(f"chaos rule kind must be one of {KINDS}, "
+                             f"got {kind!r}")
+        if kind in ("partition", "blackout") and window_s is None:
+            raise ValueError(f"chaos {kind!r} rule requires window_s")
+        if target is not None and target not in TARGETS:
+            raise ValueError(f"chaos rule target must be one of {TARGETS} "
+                             f"or None, got {target!r}")
+        self.kind = kind
+        self.target = target
+        self.conn = conn
+        self.prob = float(prob)
+        self.max_times = max_times
+        self.after_bytes = int(after_bytes)
+        self.delay_ms = float(delay_ms)
+        self.truncate_to = int(truncate_to)
+        self.window_s: Optional[Tuple[float, float]] = (
+            None if window_s is None
+            else (float(window_s[0]), float(window_s[1])))
+        self.fired = 0  # lifetime firing counter (proxy bumps it)
+
+    def to_dict(self) -> dict:
+        d = {"kind": self.kind}
+        if self.conn is not None:
+            d["conn"] = self.conn
+        if self.prob != 1.0:
+            d["prob"] = self.prob
+        if self.max_times is not None:
+            d["max_times"] = self.max_times
+        if self.after_bytes:
+            d["after_bytes"] = self.after_bytes
+        if self.delay_ms:
+            d["delay_ms"] = self.delay_ms
+        if self.truncate_to:
+            d["truncate_to"] = self.truncate_to
+        if self.window_s is not None:
+            d["window_s"] = list(self.window_s)
+        if self.target is not None:
+            d["target"] = self.target
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Rule":
+        known = {"kind", "conn", "prob", "max_times", "after_bytes",
+                 "delay_ms", "truncate_to", "window_s", "target"}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(f"unknown chaos rule field(s) {sorted(extra)}")
+        return cls(**d)
+
+
+class Schedule:
+    """Seeded rule set. ``decide(conn_index)`` resolves, without any
+    shared-RNG ordering hazards, which rules apply to that connection."""
+
+    def __init__(self, rules: Sequence[Rule] = (), seed: int = 0):
+        self.rules: List[Rule] = list(rules)
+        self.seed = int(seed)
+
+    # -- construction -----------------------------------------------------
+    @classmethod
+    def from_spec(cls, spec) -> "Schedule":
+        """dict / JSON string / ``@file.json`` / Schedule passthrough /
+        None -> empty schedule."""
+        if spec is None:
+            return cls()
+        if isinstance(spec, Schedule):
+            return spec
+        if isinstance(spec, str):
+            if spec.startswith("@"):
+                with open(spec[1:]) as f:
+                    spec = json.load(f)
+            else:
+                spec = json.loads(spec)
+        if not isinstance(spec, dict):
+            raise ValueError(
+                f"chaos spec must be a dict, got {type(spec).__name__}")
+        rules = [Rule.from_dict(r) for r in spec.get("rules", [])]
+        return cls(rules, seed=int(spec.get("seed", 0)))
+
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed,
+                           "rules": [r.to_dict() for r in self.rules]})
+
+    def reseed(self, salt: int) -> "Schedule":
+        """An independent same-rules schedule (fresh ``fired`` counters)
+        for another proxy in the same run — per-target determinism
+        without cross-proxy counter sharing."""
+        return Schedule([Rule.from_dict(r.to_dict()) for r in self.rules],
+                        seed=self.seed + int(salt))
+
+    def for_target(self, target: str) -> "Schedule":
+        """The sub-schedule a ``target``-class proxy should run: rules
+        scoped to that target plus unscoped (``target=None``) rules.
+        Rule identity is preserved (no copy), so rule indices shift —
+        pair with :meth:`reseed` (which copies) before handing the
+        result to a proxy, as ``_ChaosFarm`` does."""
+        if target not in TARGETS:
+            raise ValueError(f"chaos target must be one of {TARGETS}, "
+                             f"got {target!r}")
+        return Schedule([r for r in self.rules
+                         if r.target is None or r.target == target],
+                        seed=self.seed)
+
+    # -- resolution -------------------------------------------------------
+    def _drawn(self, rule_idx: int, conn_index: int) -> bool:
+        rule = self.rules[rule_idx]
+        if rule.prob >= 1.0:
+            return True
+        # explicit integer key: tuple seeding would ride hash(), which
+        # is only deterministic for ints — keep the contract visible
+        key = (self.seed * 1_000_003 + rule_idx) * 1_000_003 + conn_index
+        return random.Random(key).random() < rule.prob
+
+    def decide(self, conn_index: int) -> List[Rule]:
+        """Rules that apply to the ``conn_index``-th accepted
+        connection. ``max_times`` budgeting happens at fire time (the
+        proxy calls :meth:`consume`), since a selected rule may never
+        trigger (e.g. ``after_bytes`` beyond the transfer size)."""
+        out = []
+        for i, rule in enumerate(self.rules):
+            if rule.conn is not None and rule.conn != conn_index:
+                continue
+            if rule.max_times is not None and rule.fired >= rule.max_times:
+                continue
+            if not self._drawn(i, conn_index):
+                continue
+            out.append(rule)
+        return out
+
+    @staticmethod
+    def consume(rule: Rule) -> bool:
+        """Try to spend one firing of ``rule``; False when its
+        ``max_times`` budget is already gone (another connection beat
+        this one to it)."""
+        if rule.max_times is not None and rule.fired >= rule.max_times:
+            return False
+        rule.fired += 1
+        return True

@@ -50,6 +50,7 @@ import contextlib
 import ctypes
 import os
 import sys
+import time
 from typing import Callable, Optional
 
 import numpy as np
@@ -198,6 +199,15 @@ class XlaDataPlane:
         import jax
         from jax._src.distributed import global_state
         from jax._src.lib import _jax
+        # recovery accounting: a re-formation in a process that already
+        # had a world means the epoch advanced under it (a peer died and
+        # the fleet rewired); the span carries how long the device world
+        # was down for this rank
+        t0 = time.perf_counter()
+        was_formed = self._formed_epoch is not None
+        if was_formed:
+            telemetry.count("recovery.epoch_advance",
+                            provenance="recovery")
         self._teardown()
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         self._rank = int(self._lib.RbtGetRank())
@@ -240,6 +250,10 @@ class XlaDataPlane:
         self._mesh = Mesh(np.array([reps[i] for i in sorted(reps)]),
                           ("proc",))
         self._formed_epoch = epoch
+        telemetry.record_span("recovery.world_reform",
+                              time.perf_counter() - t0,
+                              provenance="recovery", epoch=epoch,
+                              reformed=was_formed)
         if self.on_world_reformed is not None:
             self.on_world_reformed(epoch)
 
@@ -289,6 +303,11 @@ class XlaDataPlane:
         except Exception as e:  # noqa: BLE001 — must not unwind into C
             print(f"[dataplane] rank {self._rank} epoch {epoch} failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
+            # the nonzero return becomes a link reset on the C++ side:
+            # count it under recovery provenance so fleet tables show
+            # how many collectives escalated into the recovery path
+            telemetry.count("recovery.link_reset", op="dataplane",
+                            provenance="recovery")
             try:
                 self._teardown()
             except Exception:  # pragma: no cover - best-effort

@@ -23,9 +23,11 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from .base import Engine
+from . import ckpt_store
 from .. import telemetry
 from ..ops.reducers import DTYPE_ENUM, OP_NAMES
 from ..utils import log
+from ..utils.watchdog import Watchdog
 
 _LIB_ENV = "RABIT_TPU_CORE_LIB"
 
@@ -132,6 +134,13 @@ class NativeEngine(Engine):
         self._dataplane = None
         # env name -> (value before our first export, our exported value)
         self._env_exports: dict = {}
+        self._watchdog = Watchdog()  # disabled until init reads config
+        # durable cold-restart mirror (rabit_ckpt_dir); None = memory-only
+        self._store: Optional[ckpt_store.CheckpointStore] = None
+        # absolute version = native version + offset: the native counter
+        # restarts at 0 on a cold restart while the durable store keeps
+        # counting, so the app-visible version_number never goes backward
+        self._version_offset = 0
 
     def _cache_key(self, site: str, size: int) -> bytes:
         """Deterministic replay key: caller site + payload size + an
@@ -200,10 +209,20 @@ class NativeEngine(Engine):
             # device-world coordinator on demand
             argv.append("rabit_dataplane=xla")
         arr = (ctypes.c_char_p * len(argv))(*[a.encode() for a in argv])
-        self._check(self._lib.RbtInit(len(argv), arr), "init")
+        self._watchdog = Watchdog.from_config(cfg)
+        # bootstrap is a guarded phase too: a tracker that accepted the
+        # connection but never completes assignment would otherwise
+        # hang the worker forever with no error to react to
+        with self._watchdog.guard("engine.init"):
+            self._check(self._lib.RbtInit(len(argv), arr), "init")
         log.set_debug(cfg.get_bool("rabit_debug"))
         log.set_identity(self.rank, self.world_size)
         telemetry.configure(cfg)
+        ckpt_dir = cfg.get("rabit_ckpt_dir")
+        if ckpt_dir:
+            self._store = ckpt_store.CheckpointStore(
+                ckpt_dir, rank=self.rank,
+                keep=cfg.get_int("rabit_ckpt_keep", ckpt_store.DEFAULT_KEEP))
         if kind == "xla" and self.is_distributed:
             from .dataplane import XlaDataPlane
             self._export_env("RABIT_DATAPLANE_WIRE",
@@ -227,6 +246,17 @@ class NativeEngine(Engine):
         """The tracker's link-registration epoch — advances exactly when
         the worker set was rewired (a recovery happened)."""
         return int(self._lib.RbtWorldEpoch())
+
+    def _on_stall(self) -> None:
+        """Watchdog escalation hook: error the blocked device collective
+        by tearing the device world down — the data-plane callback then
+        returns nonzero to C++, which treats it as a link reset and
+        replays (doc/fault_tolerance.md). Host-side (pure C++ socket)
+        stalls are unreachable from here; the watchdog's grace-abort
+        handles those."""
+        dp = self._dataplane
+        if dp is not None and dp.formed:
+            dp.shutdown()
 
     def set_world_reformed_callback(self, fn) -> None:
         """``fn(epoch)`` fires after each device-world re-formation; use
@@ -255,6 +285,7 @@ class NativeEngine(Engine):
             except Exception as e:  # noqa: BLE001 - never block shutdown
                 log.log_warn("telemetry flush failed: %s", e)
         self._restore_env()
+        self._watchdog.close()
         self._check(self._lib.RbtFinalize(), "finalize")
 
     def allreduce(self, buf: np.ndarray, op: int,
@@ -271,8 +302,11 @@ class NativeEngine(Engine):
             def trampoline(_arg, fn=prepare_fun):
                 fn()
             cb = _PREPARE_CB(trampoline)
-        with telemetry.span("engine.allreduce", nbytes=buf.nbytes,
-                            op=OP_NAMES.get(op, str(op)), method="native"):
+        with self._watchdog.guard("engine.allreduce", nbytes=buf.nbytes,
+                                  on_expire=self._on_stall), \
+                telemetry.span("engine.allreduce", nbytes=buf.nbytes,
+                               op=OP_NAMES.get(op, str(op)),
+                               method="native"):
             rc = self._lib.RbtAllreduceEx(
                 buf.ctypes.data_as(ctypes.c_void_p), buf.size, dtype_enum,
                 op, cb, None, cache_key)
@@ -286,17 +320,21 @@ class NativeEngine(Engine):
             if data is None:
                 raise ValueError("root must provide broadcast data")
             length[0] = len(data)
-        rc = self._lib.RbtBroadcastEx(
-            length.ctypes.data_as(ctypes.c_void_p), 8, root,
-            self._cache_key(site + "/len", 8))
+        with self._watchdog.guard("engine.broadcast.size", nbytes=8,
+                                  on_expire=self._on_stall):
+            rc = self._lib.RbtBroadcastEx(
+                length.ctypes.data_as(ctypes.c_void_p), 8, root,
+                self._cache_key(site + "/len", 8))
         self._check(rc, "broadcast(size)")
         n = int(length[0])
         payload = ctypes.create_string_buffer(n)
         if self.rank == root and n:
             payload.raw = data
         if n:
-            with telemetry.span("engine.broadcast", nbytes=n,
-                                method="native", root=root):
+            with self._watchdog.guard("engine.broadcast", nbytes=n,
+                                      on_expire=self._on_stall), \
+                    telemetry.span("engine.broadcast", nbytes=n,
+                                   method="native", root=root):
                 rc = self._lib.RbtBroadcastEx(
                     ctypes.cast(payload, ctypes.c_void_p), n, root,
                     self._cache_key(site + "/payload", n))
@@ -305,38 +343,126 @@ class NativeEngine(Engine):
 
     def load_checkpoint(self, with_local: bool = False
                         ) -> Tuple[int, Optional[bytes], Optional[bytes]]:
-        gptr = ctypes.POINTER(ctypes.c_char)()
-        glen = ctypes.c_uint64()
-        if with_local:
-            lptr = ctypes.POINTER(ctypes.c_char)()
-            llen = ctypes.c_uint64()
-            version = self._lib.RbtLoadCheckpoint(
-                ctypes.byref(gptr), ctypes.byref(glen),
-                ctypes.byref(lptr), ctypes.byref(llen))
-        else:
-            lptr = llen = None
-            version = self._lib.RbtLoadCheckpoint(
-                ctypes.byref(gptr), ctypes.byref(glen), None, None)
+        with self._watchdog.guard("engine.load_checkpoint",
+                                  on_expire=self._on_stall):
+            gptr = ctypes.POINTER(ctypes.c_char)()
+            glen = ctypes.c_uint64()
+            if with_local:
+                lptr = ctypes.POINTER(ctypes.c_char)()
+                llen = ctypes.c_uint64()
+                version = self._lib.RbtLoadCheckpoint(
+                    ctypes.byref(gptr), ctypes.byref(glen),
+                    ctypes.byref(lptr), ctypes.byref(llen))
+            else:
+                lptr = llen = None
+                version = self._lib.RbtLoadCheckpoint(
+                    ctypes.byref(gptr), ctypes.byref(glen), None, None)
         if version < 0:
             self._check(-1, "load_checkpoint")
         gbytes = bytes(gptr[:glen.value]) if version > 0 else None
         lbytes = None
         if with_local and version > 0 and llen.value:
             lbytes = bytes(lptr[:llen.value])
+        if self._store is not None:
+            if version > 0 and gbytes is not None \
+                    and ckpt_store.is_wrapped(gbytes):
+                # durable-mode checkpoints carry the absolute version
+                # inside the replicated payload (see checkpoint below);
+                # recover the offset from it — this is how a respawned
+                # worker whose native counter restarted at 0 still
+                # reports the absolute version after in-memory recovery
+                abs_v, gbytes, _ = ckpt_store.decode_record(gbytes)
+                self._version_offset = abs_v - version
+            elif version == 0:
+                # _cold_restart returns the ABSOLUTE version (it set the
+                # offset itself via _seed_native) — return it directly
+                abs_v, gbytes, lbytes = self._cold_restart(with_local)
+                self._loaded = True
+                return (abs_v, gbytes, lbytes)
         self._loaded = True
-        return (version, gbytes, lbytes)
+        shown = version + self._version_offset if version > 0 else version
+        return (shown, gbytes, lbytes)
+
+    def _cold_restart(self, with_local: bool
+                      ) -> Tuple[int, Optional[bytes], Optional[bytes]]:
+        """The whole world restarted (native version 0 everywhere) with
+        a durable store configured: agree on the newest intact stored
+        version across ranks (MAX allreduce), pick the lowest rank
+        holding it, broadcast its payload, and seed the C++ plane so
+        subsequent partial failures replay from this state. Runs before
+        ``_loaded`` flips, so these collectives get bootstrap-cache keys
+        and a worker dying mid-consensus replays them after respawn."""
+        from ..ops.reducers import MAX, MIN
+        store = self._store
+        mine = store.latest_version()
+        if not self.is_distributed or self.world_size == 1:
+            got = store.latest()
+            if got is None:
+                return (0, None, None)
+            v, g, l = got
+            self._seed_native(v, g, l or None)
+            return (v, g, (l or None) if with_local else None)
+        word = np.array([mine], dtype=np.int64)
+        self.allreduce(word, MAX, key="ckpt_store/max_version")
+        maxv = int(word[0])
+        if maxv <= 0:
+            return (0, None, None)
+        word[0] = self.rank if mine >= maxv else self.world_size
+        self.allreduce(word, MIN, key="ckpt_store/holder")
+        root = int(word[0])
+        payload = None
+        if self.rank == root:
+            got = store.load(maxv)
+            payload = got[0] if got is not None else b""
+        g = self.broadcast(payload, root)
+        local = None
+        if with_local:
+            got = store.load(maxv)  # local state never leaves the rank
+            if got is not None and got[1]:
+                local = got[1]
+        self._seed_native(maxv, g, local)
+        telemetry.count("recovery.cold_restart", nbytes=len(g),
+                        provenance="recovery")
+        log.log_warn("cold restart: resumed at checkpoint version %d "
+                     "(holder rank %d)", maxv, root)
+        return (maxv, g, local)
+
+    def _seed_native(self, abs_v: int, global_bytes: bytes,
+                     local_bytes: Optional[bytes]) -> None:
+        payload = ckpt_store.encode_record(abs_v, global_bytes)
+        rc = self._lib.RbtCheckpoint(
+            payload, len(payload),
+            local_bytes, 0 if local_bytes is None else len(local_bytes))
+        self._check(rc, "checkpoint(cold-restart seed)")
+        self._version_offset = abs_v - int(self._lib.RbtVersionNumber())
 
     def checkpoint(self, global_bytes: bytes,
                    local_bytes: Optional[bytes] = None) -> None:
+        payload, abs_v = global_bytes, 0
+        if self._store is not None:
+            # wrap the absolute version INSIDE the replicated payload:
+            # it then rides the ring's own replication/replay machinery,
+            # so every path that can hand this checkpoint back (peer
+            # recovery, replay, cold restart) hands the version with it
+            abs_v = self.version_number + 1
+            payload = ckpt_store.encode_record(abs_v, global_bytes)
         rc = self._lib.RbtCheckpoint(
-            global_bytes, len(global_bytes),
+            payload, len(payload),
             local_bytes, 0 if local_bytes is None else len(local_bytes))
         self._check(rc, "checkpoint")
+        if self._store is not None:
+            self._store.save(abs_v, global_bytes, local_bytes or b"")
 
     def lazy_checkpoint(self, make_global: Callable[[], bytes]) -> None:
         payload = make_global()  # Python can't defer across the ABI safely
-        rc = self._lib.RbtLazyCheckpoint(payload, len(payload))
+        wrapped, abs_v = payload, 0
+        if self._store is not None:
+            abs_v = self.version_number + 1
+            wrapped = ckpt_store.encode_record(abs_v, payload)
+        rc = self._lib.RbtLazyCheckpoint(wrapped, len(wrapped))
         self._check(rc, "lazy_checkpoint")
+        if self._store is not None:
+            self._store.save(abs_v, payload)
 
     def tracker_print(self, msg: str) -> None:
         self._check(self._lib.RbtTrackerPrint(msg.encode()), "tracker_print")
@@ -374,4 +500,7 @@ class NativeEngine(Engine):
         v = self._lib.RbtVersionNumber()
         if v < 0:
             self._check(-1, "version_number")
-        return v
+        # absolute (durable) version: the native counter restarts at 0
+        # on cold restart; the offset recovered in load_checkpoint keeps
+        # the app-visible sequence monotonic across world restarts
+        return v + self._version_offset if v > 0 else v

@@ -31,10 +31,12 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 from .base import Engine
+from . import ckpt_store
 from .. import telemetry
 from ..utils.config import Config
 from ..utils import log
 from ..utils.log import log_debug
+from ..utils.watchdog import Watchdog
 
 
 def _experimental_enable_x64():
@@ -57,6 +59,8 @@ class XlaEngine(Engine):
         self._wire: Optional[str] = None
         self._wire_mincount = 0
         self._debug = False
+        self._watchdog = Watchdog()  # disabled until init reads config
+        self._store: Optional[ckpt_store.CheckpointStore] = None
 
     def init(self, args: List[str]) -> None:
         import jax
@@ -107,6 +111,12 @@ class XlaEngine(Engine):
         log.set_debug(self._debug)
         log.set_identity(self._rank, self._world)
         telemetry.configure(cfg)
+        self._watchdog = Watchdog.from_config(cfg)
+        ckpt_dir = cfg.get("rabit_ckpt_dir")
+        if ckpt_dir:
+            self._store = ckpt_store.CheckpointStore(
+                ckpt_dir, rank=self._rank,
+                keep=cfg.get_int("rabit_ckpt_keep", ckpt_store.DEFAULT_KEEP))
         if self._world > 1:
             self._mesh = self._build_mesh()
 
@@ -160,7 +170,8 @@ class XlaEngine(Engine):
                    else _experimental_enable_x64())
         else:
             ctx = contextlib.nullcontext()
-        with sp, ctx:
+        wd = self._watchdog.guard("engine.allreduce", nbytes=buf.nbytes)
+        with wd, sp, ctx:
             sharding = NamedSharding(mesh, P("proc"))
             local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
             xs = jax.make_array_from_single_device_arrays(
@@ -212,11 +223,50 @@ class XlaEngine(Engine):
     # In-memory, version-prefixed, like the reference's global_checkpoint
     # string (allreduce_robust.cc:443-451). Replay/recovery semantics are
     # provided by the robust C++ engine; here checkpoints make single- and
-    # healthy-multi-process runs resumable in-process.
+    # healthy-multi-process runs resumable in-process — and, with
+    # ``rabit_ckpt_dir``, across process restarts via the durable store.
     def load_checkpoint(self, with_local: bool = False
                         ) -> Tuple[int, Optional[bytes], Optional[bytes]]:
         self._materialize_lazy()
+        if self._version == 0 and self._store is not None:
+            self._cold_restart(with_local)
         return (self._version, self._global, self._local)
+
+    def _cold_restart(self, with_local: bool) -> None:
+        """Fresh process with a durable store: resume from the newest
+        stored version the world agrees on (doc/fault_tolerance.md).
+        Single-process loads its own newest; multi-process runs the
+        MAX-version / MIN-holder / broadcast consensus so every rank
+        resumes the SAME version even when some ranks' disks lag."""
+        store = self._store
+        mine = store.latest_version()
+        if self._world == 1:
+            got = store.latest()
+            if got is None:
+                return
+            self._version, self._global = got[0], got[1]
+            self._local = got[2] or None
+            return
+        from ..ops.reducers import MAX as OP_MAX, MIN as OP_MIN
+        word = np.array([mine], dtype=np.int64)
+        self.allreduce(word, OP_MAX)
+        maxv = int(word[0])
+        if maxv <= 0:
+            return
+        word[0] = self._rank if mine >= maxv else self._world
+        self.allreduce(word, OP_MIN)
+        root = int(word[0])
+        payload = None
+        if self._rank == root:
+            got = store.load(maxv)
+            payload = got[0] if got is not None else b""
+        self._global = self.broadcast(payload, root)
+        self._version = maxv
+        if with_local:
+            got = store.load(maxv)  # local state never leaves the rank
+            self._local = (got[1] or None) if got is not None else None
+        telemetry.count("recovery.cold_restart",
+                        nbytes=len(self._global), provenance="recovery")
 
     def checkpoint(self, global_bytes: bytes,
                    local_bytes: Optional[bytes] = None) -> None:
@@ -224,6 +274,9 @@ class XlaEngine(Engine):
         self._local = local_bytes
         self._lazy = None
         self._version += 1
+        if self._store is not None:
+            self._store.save(self._version, global_bytes,
+                             local_bytes or b"")
 
     def lazy_checkpoint(self, make_global: Callable[[], bytes]) -> None:
         self._lazy = make_global
@@ -234,6 +287,8 @@ class XlaEngine(Engine):
         if self._lazy is not None:
             self._global = self._lazy()
             self._lazy = None
+            if self._store is not None:
+                self._store.save(self._version, self._global)
 
     # -- properties -------------------------------------------------------
     @property

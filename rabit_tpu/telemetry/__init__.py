@@ -61,6 +61,14 @@ def record_span(name: str, dur_s: float, nbytes: int = 0, **kw) -> None:
     _REC.record_span(name, dur_s, nbytes=nbytes, **kw)
 
 
+def count(name: str, nbytes: int = 0, op=None, method=None, wire=None,
+          provenance: str = "") -> None:
+    """Counter-only event (no span) — e.g. a watchdog expiry or one
+    recovery step. Keyed like spans so the fleet merge aggregates it."""
+    _REC.count(name, nbytes=nbytes, op=op, method=method, wire=wire,
+               provenance=provenance)
+
+
 def record_dispatch(n: int, itemsize: int, op: str, method: str,
                     wire: Optional[str], provenance: str) -> None:
     """One ``dispatch.resolve()`` outcome: which schedule/wire an
@@ -144,17 +152,20 @@ def ship_to_tracker(rank: int = -1, world_size: int = 0,
                or os.environ.get("DMLC_TASK_ID") or "0")
     doc = build_summary(_REC.snapshot(), rank=rank, world_size=world_size)
     payload = json.dumps(doc)
-    import socket
 
     from ..tracker.tracker import MAGIC, _recv_u32, _send_str, _send_u32
+    from ..utils import retry
     try:
-        with socket.create_connection((host, int(port)),
-                                      timeout=timeout) as conn:
+        # backoff-retried connect: a tracker mid-restart (or behind a
+        # chaos blackout window) still gets this rank's metrics
+        with retry.connect_with_retry(
+                host, int(port), timeout=timeout,
+                deadline=retry.Deadline(timeout)) as conn:
             _send_u32(conn, MAGIC)
             _send_str(conn, "metrics")
             _send_str(conn, task_id)
             _send_u32(conn, 0)  # num_attempt (informational)
             _send_str(conn, payload)
             return _recv_u32(conn) == 1
-    except (OSError, ValueError, ConnectionError):
+    except (OSError, ValueError, ConnectionError, retry.RetryError):
         return False

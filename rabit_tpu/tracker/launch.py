@@ -15,16 +15,69 @@ import argparse
 import os
 import subprocess
 import sys
+import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from .tracker import Tracker
+
+
+class _ChaosFarm:
+    """Per-run proxy fleet for ``launch(chaos=...)``: one proxy fronts
+    the tracker, plus one per distinct worker link listener, created
+    lazily from the tracker's ``link_rewrite`` hook (listen ports are
+    only known at registration, and change across respawns). Every
+    proxy runs the schedule filtered to its target class (``tracker``
+    vs ``link``, unscoped rules run on both) and reseeded per proxy,
+    so faults stay deterministic per-link without sharing
+    ``max_times`` budgets."""
+
+    def __init__(self, schedule):
+        from ..chaos.schedule import Schedule
+        self.schedule = Schedule.from_spec(schedule)
+        self._lock = threading.Lock()
+        self._by_target: Dict[Tuple[str, int], object] = {}
+        self.tracker_proxy = None
+
+    def front_tracker(self, tracker: Tracker):
+        from ..chaos.proxy import ChaosProxy
+        self.tracker_proxy = ChaosProxy(
+            tracker.host, tracker.port,
+            self.schedule.for_target("tracker").reseed(0),
+            name="chaos-tracker").start()
+        return self.tracker_proxy
+
+    def link_rewrite(self, peer_rank: int, host: str,
+                     port: int) -> Tuple[str, int]:
+        from ..chaos.proxy import ChaosProxy
+        with self._lock:
+            proxy = self._by_target.get((host, port))
+            if proxy is None:
+                proxy = ChaosProxy(
+                    host, port,
+                    self.schedule.for_target("link").reseed(1 + peer_rank),
+                    name=f"chaos-link-r{peer_rank}").start()
+                self._by_target[(host, port)] = proxy
+        return proxy.host, proxy.port
+
+    def stop(self) -> Dict[str, int]:
+        with self._lock:
+            proxies = list(self._by_target.values())
+            self._by_target.clear()
+        if self.tracker_proxy is not None:
+            proxies.append(self.tracker_proxy)
+            self.tracker_proxy = None
+        events = 0
+        for p in proxies:
+            events += len(p.events)
+            p.stop()
+        return {"proxies": len(proxies), "events": events}
 
 
 def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
            timeout: float = 300.0, quiet: bool = False,
            coordinator: Optional[bool] = None,
-           stats: Optional[Dict] = None) -> int:
+           stats: Optional[Dict] = None, chaos=None) -> int:
     """Run ``cmd`` as ``nworkers`` local processes under a tracker.
     Returns 0 on success. Workers exiting nonzero are respawned with an
     incremented attempt counter until ``max_attempts``. ``coordinator``
@@ -33,11 +86,28 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
     worker command / environment. Workers additionally advertise
     data-plane need in their tracker-registration flags, so the
     coordinator is hosted on demand even when the data plane was
-    selected through the Python engine API (invisible here)."""
+    selected through the Python engine API (invisible here).
+
+    ``chaos`` (a :class:`rabit_tpu.chaos.Schedule` spec: dict, JSON
+    string, ``@file.json``, or the ``rabit_chaos``/``RABIT_CHAOS`` env
+    default) interposes fault-injection proxies on every socket path:
+    workers rendezvous with the tracker through one proxy, and the
+    tracker rewrites advertised peer addresses through per-link proxies
+    — so scheduled delays/resets/partitions/blackouts hit live
+    registration and collective traffic (doc/fault_tolerance.md)."""
     if coordinator is None:
         coordinator = (os.environ.get("RABIT_DATAPLANE") == "xla"
                        or any(a == "rabit_dataplane=xla" for a in cmd))
-    tracker = Tracker(nworkers, coordinator=coordinator).start()
+    if chaos is None:
+        chaos = os.environ.get("RABIT_CHAOS") or None
+    farm = _ChaosFarm(chaos) if chaos is not None else None
+    tracker = Tracker(
+        nworkers, coordinator=coordinator,
+        link_rewrite=farm.link_rewrite if farm else None).start()
+    tracker_addr = (tracker.host, tracker.port)
+    if farm is not None:
+        proxy = farm.front_tracker(tracker)
+        tracker_addr = (proxy.host, proxy.port)
     procs: Dict[int, subprocess.Popen] = {}
     attempts: Dict[int, int] = {i: 0 for i in range(nworkers)}
     finished: Dict[int, bool] = {i: False for i in range(nworkers)}
@@ -45,6 +115,9 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
     def spawn(i: int) -> None:
         env = dict(os.environ)
         env.update(tracker.env(task_id=str(i), num_attempt=attempts[i]))
+        # chaos: workers rendezvous through the tracker-front proxy
+        env["RABIT_TRACKER_URI"] = tracker_addr[0]
+        env["RABIT_TRACKER_PORT"] = str(tracker_addr[1])
         procs[i] = subprocess.Popen(cmd, env=env)
 
     try:
@@ -88,9 +161,21 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
             # must stay bounded no matter how many recovery epochs ran
             stats["services_retained"] = tracker.service_count()
             stats["total_attempts"] = sum(attempts.values())
+            # fleet-merged telemetry (per-rank summaries shipped via the
+            # metrics command) — how cluster tests assert that recovery
+            # spans/counters actually fired on the workers
+            stats["fleet_metrics"] = tracker.merged_metrics()
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
+        if farm is not None:
+            chaos_stats = farm.stop()
+            if stats is not None:
+                stats["chaos"] = chaos_stats
+            if not quiet and chaos_stats["events"]:
+                print(f"[launch] chaos injected {chaos_stats['events']} "
+                      f"fault(s) across {chaos_stats['proxies']} proxies",
+                      file=sys.stderr, flush=True)
         tracker.stop()
 
 
@@ -99,6 +184,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("--max-attempts", type=int, default=20)
     ap.add_argument("--timeout", type=float, default=300.0)
+    ap.add_argument("--chaos", default=None, metavar="SPEC",
+                    help="fault-injection schedule: JSON, @file.json "
+                         "(default: RABIT_CHAOS env)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if args.cmd and args.cmd[0] == "--":
@@ -106,7 +194,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.cmd:
         ap.error("missing worker command")
     return launch(args.num_workers, args.cmd, args.max_attempts,
-                  args.timeout)
+                  args.timeout, chaos=args.chaos)
 
 
 if __name__ == "__main__":

@@ -45,6 +45,7 @@ extra consensus round.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import struct
 import sys
@@ -117,9 +118,21 @@ def _require_coordinator_api():
     return _jax
 
 
+def _default_ready_timeout() -> float:
+    """``rabit_tracker_ready_timeout`` knob (doc/parameters.md): how
+    long ``_assign`` waits for each worker's ready ack before declaring
+    the epoch partially failed."""
+    try:
+        return float(os.environ.get("RABIT_TRACKER_READY_TIMEOUT", 60.0))
+    except ValueError:
+        return 60.0
+
+
 class Tracker:
     def __init__(self, nworkers: int, host: str = "127.0.0.1", port: int = 0,
-                 coordinator: bool = False, ready_timeout: float = 60.0):
+                 coordinator: bool = False,
+                 ready_timeout: Optional[float] = None,
+                 link_rewrite=None):
         self.nworkers = nworkers
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -149,7 +162,15 @@ class Tracker:
         # policing is disabled (huge timeout) — a dead worker must not
         # poison the survivors' agents.
         self._coordinator = coordinator
-        self._ready_timeout = ready_timeout
+        self._ready_timeout = (ready_timeout if ready_timeout is not None
+                               else _default_ready_timeout())
+        # chaos hook: ``link_rewrite(peer_rank, host, port) -> (host,
+        # port)`` rewrites the peer addresses advertised in _assign so
+        # worker->worker links route through fault-injection proxies.
+        # Rewritten peers get an EMPTY uds_token: the UDS fast path
+        # would bypass a TCP proxy entirely (the token resolves on the
+        # peer's host, not at the proxy).
+        self._link_rewrite = link_rewrite
         # (epoch, service) pairs; older epochs reaped once a newer epoch
         # fully acks (every live client has dropped its old-world client
         # before acking — see the teardown-before-ack contract in
@@ -432,10 +453,15 @@ class Tracker:
                 _send_u32(conn, ring_next)
                 _send_u32(conn, len(connect_to))
                 for r in connect_to:
+                    peer_host, peer_port, peer_tok = addr[r]
+                    if self._link_rewrite is not None:
+                        peer_host, peer_port = self._link_rewrite(
+                            r, peer_host, peer_port)
+                        peer_tok = ""  # UDS would bypass the proxy
                     _send_u32(conn, r)
-                    _send_str(conn, addr[r][0])
-                    _send_u32(conn, addr[r][1])
-                    _send_str(conn, addr[r][2])
+                    _send_str(conn, peer_host)
+                    _send_u32(conn, int(peer_port))
+                    _send_str(conn, peer_tok)
                 _send_u32(conn, naccept)
             except OSError:
                 pass

@@ -35,6 +35,14 @@ ENV_VARS = [
     "RABIT_TELEMETRY",
     "RABIT_TELEMETRY_BUFFER",
     "RABIT_TELEMETRY_EXPORT",
+    "RABIT_TRACKER_READY_TIMEOUT",
+    "RABIT_DATAPLANE_INIT_TIMEOUT",
+    "RABIT_DEADLINE_MS",
+    "RABIT_DEADLINE_MS_PER_MB",
+    "RABIT_WATCHDOG_ABORT",
+    "RABIT_CKPT_DIR",
+    "RABIT_CKPT_KEEP",
+    "RABIT_CHAOS",
     "RABIT_WORLD_SIZE",
     "RABIT_RANK",
     "rabit_world_size",

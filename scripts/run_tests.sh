@@ -28,6 +28,9 @@ echo "== tier 0b: telemetry smoke (record -> export -> trace_report) =="
 JAX_PLATFORMS=cpu python tools/trace_report.py --smoke \
     --dir /tmp/rabit_telemetry_smoke
 
+echo "== tier 0c: chaos smoke (proxy -> injected reset -> retry) =="
+python -m rabit_tpu.chaos --smoke
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

@@ -1,0 +1,494 @@
+"""Tier-1 chaos/robustness units: seeded fault schedules, the
+fault-injection proxy's byte-level behavior for every fault kind, the
+retry/backoff helpers, the collective watchdog's escalation ladder, and
+the R001 lint rule — all in-process, no tracker or native build
+(doc/fault_tolerance.md; the cluster-level scenarios live in
+test_chaos_cluster.py)."""
+
+import ast
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from rabit_tpu import telemetry
+from rabit_tpu.chaos import ChaosProxy, Rule, Schedule
+from rabit_tpu.utils import retry
+from rabit_tpu.utils.config import Config
+from rabit_tpu.utils.watchdog import (
+    NULL_GUARD, WATCHDOG_EXIT_CODE, Watchdog, scale_deadline_s)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- servers ---------------------------------------------------------------
+
+def _serve(handler):
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    srv.settimeout(10.0)
+
+    def loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            try:
+                handler(conn)
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    threading.Thread(target=loop, daemon=True).start()
+    return srv
+
+
+def _echo_server():
+    def echo(conn):
+        while True:
+            data = conn.recv(65536)
+            if not data:
+                return
+            conn.sendall(data)
+    return _serve(echo)
+
+
+def _sink_server():
+    def sink(conn):
+        while conn.recv(65536):
+            pass
+    return _serve(sink)
+
+
+def _round_trip(host, port, payload, timeout=10.0):
+    """Send ``payload``, half-close, read the echo until EOF."""
+    with socket.create_connection((host, port), timeout=timeout) as conn:
+        conn.sendall(payload)
+        conn.shutdown(socket.SHUT_WR)
+        out = b""
+        while True:
+            chunk = conn.recv(65536)
+            if not chunk:
+                return out
+            out += chunk
+
+
+# -- schedule --------------------------------------------------------------
+
+def test_rule_validation():
+    with pytest.raises(ValueError, match="kind"):
+        Rule("explode")
+    with pytest.raises(ValueError, match="window_s"):
+        Rule("partition")
+    with pytest.raises(ValueError, match="window_s"):
+        Rule("blackout")
+    with pytest.raises(ValueError, match="unknown chaos rule field"):
+        Rule.from_dict({"kind": "delay", "sverity": 9})
+    with pytest.raises(ValueError, match="target"):
+        Rule("delay", target="worker")
+
+
+def test_schedule_from_spec_shapes(tmp_path):
+    assert Schedule.from_spec(None).rules == []
+    s = Schedule.from_spec({"seed": 4, "rules": [{"kind": "delay",
+                                                  "delay_ms": 5}]})
+    assert s.seed == 4 and s.rules[0].kind == "delay"
+    s2 = Schedule.from_spec(s)
+    assert s2 is s  # passthrough, not a copy
+    s3 = Schedule.from_spec('{"seed": 9, "rules": [{"kind": "reset"}]}')
+    assert s3.seed == 9 and s3.rules[0].kind == "reset"
+    f = tmp_path / "sched.json"
+    f.write_text(json.dumps({"seed": 2, "rules": [
+        {"kind": "blackout", "window_s": [1, 3]}]}))
+    s4 = Schedule.from_spec(f"@{f}")
+    assert s4.seed == 2 and s4.rules[0].window_s == (1.0, 3.0)
+    with pytest.raises(ValueError, match="must be a dict"):
+        Schedule.from_spec("[1, 2]")
+
+
+def test_schedule_json_roundtrip():
+    s = Schedule([Rule("partial", after_bytes=512, truncate_to=7,
+                       max_times=2, prob=0.25, conn=3),
+                  Rule("partition", window_s=(0.5, 2.0))], seed=11)
+    back = Schedule.from_spec(s.to_json())
+    assert back.seed == s.seed
+    assert [r.to_dict() for r in back.rules] == \
+        [r.to_dict() for r in s.rules]
+
+
+def test_decide_is_deterministic_per_seed():
+    def decisions(seed):
+        s = Schedule([Rule("delay", delay_ms=1, prob=0.5)], seed=seed)
+        return [bool(s.decide(i)) for i in range(64)]
+
+    assert decisions(7) == decisions(7)  # same seed: byte-identical plan
+    assert decisions(7) != decisions(8)  # seed actually keys the draws
+    hits = sum(decisions(7))
+    assert 0 < hits < 64  # prob=0.5 is neither never nor always
+
+
+def test_decide_conn_filter_and_budget():
+    rule = Rule("reset", conn=2, max_times=1)
+    s = Schedule([rule], seed=0)
+    assert s.decide(0) == [] and s.decide(1) == []
+    assert s.decide(2) == [rule]
+    assert Schedule.consume(rule) is True
+    assert Schedule.consume(rule) is False  # budget spent
+    assert s.decide(2) == []  # exhausted rules drop out of the plan
+
+
+def test_reseed_gives_fresh_counters():
+    rule = Rule("reset", max_times=1)
+    s = Schedule([rule], seed=5)
+    Schedule.consume(rule)
+    s2 = s.reseed(3)
+    assert s2.seed == 8
+    assert s2.rules[0].fired == 0 and s2.rules[0] is not rule
+
+
+def test_for_target_scopes_rules():
+    """Target scoping: a tracker-class proxy runs tracker + unscoped
+    rules; a link-class proxy runs link + unscoped — and the target
+    survives the JSON round trip the launcher relies on."""
+    tr = Rule("blackout", window_s=(0, 1), target="tracker")
+    ln = Rule("reset", after_bytes=64, target="link")
+    both = Rule("delay", delay_ms=2)
+    s = Schedule([tr, ln, both], seed=4)
+    assert [r.kind for r in s.for_target("tracker").rules] == \
+        ["blackout", "delay"]
+    assert [r.kind for r in s.for_target("link").rules] == \
+        ["reset", "delay"]
+    assert s.for_target("tracker").seed == 4
+    with pytest.raises(ValueError, match="target"):
+        s.for_target("worker")
+    back = Schedule.from_spec(s.to_json())
+    assert [r.target for r in back.rules] == ["tracker", "link", None]
+
+
+# -- proxy -----------------------------------------------------------------
+
+def test_proxy_forwards_byte_exact_without_faults():
+    payload = bytes(range(256)) * 300  # ~75 KiB, content-checkable
+    srv = _echo_server()
+    try:
+        with ChaosProxy(*srv.getsockname(), Schedule()) as proxy:
+            out = _round_trip(proxy.host, proxy.port, payload)
+            assert out == payload
+            assert proxy.events == [] and proxy.accepted == 1
+            deadline = time.monotonic() + 2
+            while proxy.bytes_forwarded < 2 * len(payload) and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert proxy.bytes_forwarded == 2 * len(payload)
+    finally:
+        srv.close()
+
+
+def test_proxy_delay_slows_the_stream():
+    srv = _echo_server()
+    try:
+        sched = Schedule([Rule("delay", delay_ms=250)])
+        with ChaosProxy(*srv.getsockname(), sched) as proxy:
+            t0 = time.monotonic()
+            out = _round_trip(proxy.host, proxy.port, b"x" * 1000)
+            assert out == b"x" * 1000
+            assert time.monotonic() - t0 >= 0.25
+            assert any(e[1] == "delay" for e in proxy.events)
+    finally:
+        srv.close()
+
+
+def test_proxy_reset_tears_connection_mid_transfer():
+    payload = b"y" * 16384
+    srv = _echo_server()
+    try:
+        sched = Schedule([Rule("reset", after_bytes=4096, max_times=1)])
+        with ChaosProxy(*srv.getsockname(), sched) as proxy:
+            with pytest.raises((ConnectionError, OSError)):
+                out = _round_trip(proxy.host, proxy.port, payload)
+                if out != payload:
+                    raise ConnectionError(
+                        f"torn echo {len(out)}/{len(payload)}")
+            assert [e[1] for e in proxy.events] == ["reset"]
+            # the retry path then succeeds: budget (max_times=1) is spent
+            assert _round_trip(proxy.host, proxy.port, payload) == payload
+    finally:
+        srv.close()
+
+
+def test_proxy_partial_forwards_truncated_chunk_then_kills():
+    srv = _sink_server()
+    try:
+        sched = Schedule([Rule("partial", after_bytes=1, truncate_to=100)])
+        with ChaosProxy(*srv.getsockname(), sched) as proxy:
+            with socket.create_connection((proxy.host, proxy.port),
+                                          timeout=10.0) as conn:
+                conn.sendall(b"z" * 8192)
+                with pytest.raises((ConnectionError, OSError, AssertionError)):
+                    assert conn.recv(1) == b""  # RST or EOF, never data
+            assert [e[1] for e in proxy.events] == ["partial"]
+            deadline = time.monotonic() + 2
+            while proxy.bytes_forwarded < 100 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert proxy.bytes_forwarded == 100  # exactly the torn write
+    finally:
+        srv.close()
+
+
+def test_proxy_blackout_refuses_then_recovers_via_retry():
+    payload = b"b" * 4096
+    srv = _echo_server()
+    try:
+        sched = Schedule([Rule("blackout", window_s=(0.0, 0.6))])
+        with ChaosProxy(*srv.getsockname(), sched) as proxy:
+
+            def round_trip():
+                out = _round_trip(proxy.host, proxy.port, payload,
+                                  timeout=5.0)
+                if out != payload:
+                    raise ConnectionError("torn echo")
+                return out
+
+            assert retry.retry_call(round_trip, attempts=8, base_s=0.2,
+                                    max_s=0.4) == payload
+            assert proxy.refused >= 1
+            assert any(e[1] == "blackout" for e in proxy.events)
+    finally:
+        srv.close()
+
+
+def test_proxy_partition_stalls_inside_window_then_delivers():
+    payload = b"p" * 2048
+    srv = _echo_server()
+    try:
+        sched = Schedule([Rule("partition", window_s=(0.0, 0.7))])
+        with ChaosProxy(*srv.getsockname(), sched) as proxy:
+            t0 = time.monotonic()
+            out = _round_trip(proxy.host, proxy.port, payload)
+            elapsed = time.monotonic() - t0
+            assert out == payload  # stalled, not dropped
+            assert elapsed >= 0.4
+            assert any(e[1] == "partition" for e in proxy.events)
+    finally:
+        srv.close()
+
+
+def test_chaos_smoke_entry_point():
+    """The run_tests.sh tier-0c command, invoked in-process."""
+    from rabit_tpu.chaos.__main__ import smoke
+    assert smoke() == 0
+
+
+# -- retry -----------------------------------------------------------------
+
+def test_backoff_delay_curve_and_jitter_bounds():
+    assert retry.backoff_delay(0, base_s=0.1, jitter=0) == \
+        pytest.approx(0.1)
+    assert retry.backoff_delay(3, base_s=0.1, jitter=0) == \
+        pytest.approx(0.8)
+    assert retry.backoff_delay(10, base_s=0.1, max_s=2.0, jitter=0) == \
+        pytest.approx(2.0)  # capped
+    import random
+    for attempt in range(6):
+        d = retry.backoff_delay(attempt, base_s=0.1, max_s=2.0,
+                                jitter=0.5, rng=random.Random(1))
+        base = min(2.0, 0.1 * 2 ** attempt)
+        assert base <= d <= base * 1.5
+
+
+def test_deadline_budget():
+    d = retry.Deadline(None)
+    assert d.remaining() is None and not d.expired()
+    assert d.clamp(7.0) == 7.0
+    d = retry.Deadline(0.08)
+    assert d.clamp(100.0) <= 0.08
+    time.sleep(0.1)
+    assert d.expired() and d.clamp(1.0) == 0.0
+
+
+def test_retry_call_recovers_and_exhausts():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("boom")
+        return 42
+
+    assert retry.retry_call(flaky, attempts=5, base_s=0.001,
+                            jitter=0) == 42
+    assert len(calls) == 3
+
+    def always_down():
+        raise OSError("down")
+
+    with pytest.raises(retry.RetryError) as ei:
+        retry.retry_call(always_down, attempts=2, base_s=0.001, jitter=0)
+    assert isinstance(ei.value.last, OSError)
+
+    def unexpected():
+        raise KeyError("not retryable")
+
+    with pytest.raises(KeyError):  # only retry_on types are retried
+        retry.retry_call(unexpected, attempts=5, base_s=0.001)
+
+
+def test_connect_with_retry_survives_late_listener():
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    host, port = probe.getsockname()
+    probe.close()  # port now free: first attempts get ECONNREFUSED
+
+    srv_box = {}
+
+    def bring_up():
+        time.sleep(0.3)
+        srv = socket.socket()
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((host, port))
+        srv.listen(1)
+        srv_box["srv"] = srv
+
+    threading.Thread(target=bring_up, daemon=True).start()
+    conn = retry.connect_with_retry(host, port, timeout=2.0, attempts=10,
+                                    base_s=0.1, max_s=0.3)
+    conn.close()
+    srv_box["srv"].close()
+
+    with pytest.raises(retry.RetryError):
+        retry.connect_with_retry(host, port, timeout=0.5, attempts=2,
+                                 base_s=0.01)
+
+
+# -- watchdog --------------------------------------------------------------
+
+def test_scale_deadline():
+    assert scale_deadline_s(1 << 30, floor_ms=0) == 0.0  # disabled
+    assert scale_deadline_s(0, floor_ms=100) == pytest.approx(0.1)
+    # 64 MiB at 100 ms/MiB: payload term dominates the floor
+    assert scale_deadline_s(64 << 20, floor_ms=100) == pytest.approx(6.4)
+
+
+def test_disabled_watchdog_hands_out_null_guard():
+    wd = Watchdog()  # floor 0: disabled
+    g = wd.guard("engine.allreduce", nbytes=1 << 30)
+    assert g is NULL_GUARD
+    with g:
+        pass
+    assert not g.expired
+
+
+def test_guard_disarms_before_deadline():
+    wd = Watchdog(floor_ms=500, abort=False)
+    try:
+        with wd.guard("fast.phase") as g:
+            time.sleep(0.02)
+        assert not g.expired and wd.expired_total == 0
+    finally:
+        wd.close()
+
+
+def test_expiry_escalates_with_telemetry_and_hook():
+    telemetry.reset(enabled=True)
+    fired = []
+    wd = Watchdog(floor_ms=80, abort=False)
+    try:
+        with wd.guard("stuck.phase", nbytes=123,
+                      on_expire=lambda: fired.append(1)) as g:
+            time.sleep(0.4)
+        assert g.expired and wd.expired_total == 1
+        assert fired == [1]
+        rows = {(c["name"], c.get("provenance", ""))
+                for c in telemetry.snapshot()["counters"]}
+        assert ("watchdog.expired", "recovery") in rows
+        assert ("watchdog.stall", "recovery") in rows
+    finally:
+        wd.close()
+        telemetry.reset(enabled=False)
+
+
+def test_abort_fires_after_grace_via_seam():
+    codes = []
+    wd = Watchdog(floor_ms=100, abort=True, abort_fn=codes.append)
+    try:
+        with wd.guard("dead.phase"):
+            # deadline 0.1s + grace max(0.5, 0.1)s: abort lands ~0.6s in
+            deadline = time.monotonic() + 3.0
+            while not codes and time.monotonic() < deadline:
+                time.sleep(0.02)
+        assert codes == [WATCHDOG_EXIT_CODE]
+    finally:
+        wd.close()
+
+
+def test_abort_opt_out_stops_at_escalation():
+    codes = []
+    wd = Watchdog(floor_ms=50, abort=False, abort_fn=codes.append)
+    try:
+        with wd.guard("stuck.phase") as g:
+            time.sleep(0.7)  # well past deadline + grace
+        assert g.expired and codes == []
+    finally:
+        wd.close()
+
+
+def test_watchdog_from_config():
+    wd = Watchdog.from_config(Config({"rabit_deadline_ms": "250",
+                                      "rabit_deadline_ms_per_mb": "7",
+                                      "rabit_watchdog_abort": "0"}))
+    assert wd.enabled and wd.floor_ms == 250
+    assert wd.ms_per_mb == 7 and wd.abort is False
+    assert not Watchdog.from_config(Config({})).enabled  # opt-in default
+
+
+# -- lint rule R001 --------------------------------------------------------
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "repo_lint", os.path.join(ROOT, "tools", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_r001_flags_raw_sockets_in_control_plane():
+    lint = _load_lint()
+    src = ("import socket\n"
+           "def ship():\n"
+           "    return socket.create_connection(('h', 1))\n")
+    rel = os.path.join("rabit_tpu", "utils", "shipper.py")
+    issues = lint._r001_issues(rel, ast.parse(src), src)
+    assert [(i[1], i[2]) for i in issues] == [(3, "R001")]
+    assert "connect_with_retry" in issues[0][3]
+
+
+def test_r001_respects_noqa_allowlist_and_scope():
+    lint = _load_lint()
+    src = ("import socket\n"
+           "s = socket.socket()  # noqa: R001\n")
+    rel = os.path.join("rabit_tpu", "utils", "shipper.py")
+    assert lint._r001_issues(rel, ast.parse(src), src) == []
+    raw = "import socket\ns = socket.socket()\n"
+    allowed = os.path.join("rabit_tpu", "chaos", "proxy.py")
+    assert lint._r001_issues(allowed, ast.parse(raw), raw) == []
+    outside = os.path.join("tools", "probe.py")
+    assert lint._r001_issues(outside, ast.parse(raw), raw) == []
+
+
+def test_repo_is_r001_clean():
+    """Every rabit_tpu/ file passes the rule as wired into check_file —
+    the regression guard for the allowlist itself."""
+    lint = _load_lint()
+    bad = []
+    for path in lint.iter_py_files(["rabit_tpu"]):
+        bad += [i for i in lint.check_file(path) if i[2] == "R001"]
+    assert bad == [], bad

@@ -1,0 +1,163 @@
+"""Cluster-level chaos scenarios (slow tier): real multi-process
+worlds under injected network faults — tracker blackout at
+registration, link resets mid-collective, a partition caught by the
+watchdog, a hung bootstrap escalated to exit 86, and a durable cold
+restart — asserting both that the cluster completes AND that the
+recovery telemetry shows what it survived (doc/fault_tolerance.md)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "native", "build", "librabit_tpu_core.so")
+WORKERS = os.path.join(ROOT, "tests", "workers")
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not os.path.isfile(LIB),
+                       reason="native core not built"),
+]
+
+sys.path.insert(0, ROOT)
+
+
+def run_cluster(nworkers, worker, extra_args=(), env=None, chaos=None,
+                timeout=180, max_attempts=30):
+    """launch() wrapper returning (returncode, stats)."""
+    from rabit_tpu.tracker.launch import launch
+    cmd = [sys.executable, os.path.join(WORKERS, worker)] + list(extra_args)
+    stats = {}
+    old = {}
+    if env:
+        for k, v in env.items():
+            old[k] = os.environ.get(k)
+            os.environ[k] = v
+    try:
+        rc = launch(nworkers, cmd, max_attempts=max_attempts,
+                    timeout=timeout, stats=stats, chaos=chaos)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rc, stats
+
+
+def _counter_names(stats):
+    fleet = stats.get("fleet_metrics")
+    if not fleet:
+        return {}
+    return {(c["name"], c.get("provenance", ""))
+            for c in fleet.get("counters", [])}
+
+
+def test_registration_survives_tracker_blackout():
+    """Connections RST'd at the tracker front during the blackout
+    window: the C++ connect retry and tracker-side respawns absorb it.
+    Scoped to the tracker — a blackout on link wiring kills a peer
+    mid-handshake while its neighbors block in accept, which is
+    unrecoverable by design (see native/src/comm.cc LinkHandshake)."""
+    chaos = {"seed": 3, "rules": [
+        {"kind": "blackout", "window_s": [0.0, 2.0], "max_times": 1,
+         "target": "tracker"}]}
+    rc, stats = run_cluster(2, "basic_worker.py", chaos=chaos)
+    assert rc == 0
+    assert stats["chaos"]["events"] >= 1, "blackout never fired"
+
+
+def test_collectives_survive_link_resets():
+    """Each link proxy hard-resets its first connection once enough
+    bytes passed — mid-collective RSTs on live recovery-capable
+    workers. recover_worker's analytic checks catch any corruption the
+    replay let through."""
+    chaos = {"seed": 5, "rules": [
+        {"kind": "reset", "after_bytes": 4096, "max_times": 1,
+         "target": "link"}]}
+    rc, stats = run_cluster(4, "recover_worker.py", chaos=chaos,
+                            env={"N_ITER": "6"})
+    assert rc == 0
+    assert stats["chaos"]["events"] >= 1, "no reset ever fired"
+
+
+def test_partition_expires_watchdog_and_recovers():
+    """A partition window stalls the stream without any socket error —
+    invisible to the epoch machinery, visible to the watchdog. With
+    abort opted out the stall is reported (recovery-provenance
+    counters) and the run completes once the window passes."""
+    chaos = {"seed": 11, "rules": [
+        {"kind": "partition", "window_s": [0.0, 3.0], "max_times": 1}]}
+    rc, stats = run_cluster(
+        2, "basic_worker.py",
+        extra_args=["rabit_deadline_ms=800", "rabit_watchdog_abort=0"],
+        env={"RABIT_TELEMETRY": "1"}, chaos=chaos)
+    assert rc == 0
+    assert stats["chaos"]["events"] >= 1, "no fault fired"
+    names = _counter_names(stats)
+    assert ("watchdog.expired", "recovery") in names, names
+
+
+def test_watchdog_aborts_hung_bootstrap_with_exit_86():
+    """A worker whose world never completes rendezvous is stalled
+    inside C++ socket code: only the watchdog's grace abort can free
+    it, and the exit code must be distinguishable from a scripted
+    kill."""
+    from rabit_tpu.tracker.tracker import Tracker
+    from rabit_tpu.utils.watchdog import WATCHDOG_EXIT_CODE
+    tr = Tracker(2, ready_timeout=60.0).start()
+    try:
+        env = dict(os.environ, PYTHONPATH=ROOT)
+        env.update(tr.env(task_id="0"))
+        p = subprocess.Popen(
+            [sys.executable, os.path.join(WORKERS, "basic_worker.py"),
+             "rabit_deadline_ms=1500"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        _, err = p.communicate(timeout=60)
+        assert p.returncode == WATCHDOG_EXIT_CODE, \
+            (p.returncode, err.decode(errors="replace")[-2000:])
+        assert b"watchdog" in err.lower()
+    finally:
+        tr.stop()
+
+
+def test_cold_restart_resumes_from_durable_store(tmp_path):
+    """Whole-world death: run to v3 and stop; a second, fully fresh
+    world (native version 0 on every rank) must agree on v3 via the
+    MAX/MIN/broadcast consensus and continue to v5 — even with one
+    rank's disk lagging a version behind."""
+    ckpt = str(tmp_path / "ckpt")
+    args = [f"rabit_ckpt_dir={ckpt}", "rabit_ckpt_keep=2"]
+    rc, _ = run_cluster(4, "durable_worker.py", extra_args=args,
+                        env={"N_TARGET": "3", "EXPECT_VERSION": "0"})
+    assert rc == 0
+    for r in range(4):
+        assert os.path.isfile(
+            os.path.join(ckpt, f"r{r}", "ckpt_v3.rbt")), f"rank {r}"
+    # rank 3's disk lags: its newest checkpoint is gone
+    os.unlink(os.path.join(ckpt, "r3", "ckpt_v3.rbt"))
+
+    rc, stats = run_cluster(
+        4, "durable_worker.py", extra_args=args,
+        env={"N_TARGET": "5", "EXPECT_VERSION": "3",
+             "RABIT_TELEMETRY": "1"})
+    assert rc == 0
+    names = _counter_names(stats)
+    assert ("recovery.cold_restart", "recovery") in names, names
+    # every rank (including the laggard) caught up durably
+    from rabit_tpu.engine.ckpt_store import CheckpointStore
+    for r in range(4):
+        st = CheckpointStore(ckpt, rank=r, keep=2)
+        assert st.latest_version() == 5, f"rank {r}: {st.versions()}"
+
+
+def test_cold_restart_empty_store_starts_at_zero(tmp_path):
+    """A configured-but-empty store must behave exactly like no store:
+    version 0, no consensus payload, normal run."""
+    rc, _ = run_cluster(
+        2, "durable_worker.py",
+        extra_args=[f"rabit_ckpt_dir={tmp_path / 'none'}"],
+        env={"N_TARGET": "2", "EXPECT_VERSION": "0"})
+    assert rc == 0

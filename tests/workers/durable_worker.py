@@ -1,0 +1,52 @@
+"""Durable-checkpoint worker: iterates versioned checkpoints against a
+``rabit_ckpt_dir`` store and asserts the resume point. Launched twice
+by test_chaos_cluster.py — the second launch is a cold restart (every
+process fresh, native version 0 everywhere) and must resume at the
+version the fleet agrees on via the MAX/MIN/broadcast consensus.
+
+argv: key=value params forwarded to the engine (rabit_ckpt_dir=...)
+env:  N_TARGET (iterate until this version), EXPECT_VERSION (the
+      version load_checkpoint must report on startup)
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def main() -> None:
+    rabit.init([a for a in sys.argv[1:] if "=" in a])
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+    target = int(os.environ.get("N_TARGET", "3"))
+    expect = int(os.environ.get("EXPECT_VERSION", "0"))
+
+    version, model = rabit.load_checkpoint()
+    assert version == expect, \
+        f"rank {rank}: resumed at v{version}, expected v{expect}"
+    if version == 0:
+        model = {"step": 0}
+    # model contents are a pure function of the version: a resume with
+    # the wrong (or torn) payload fails here, not just the wrong number
+    assert model["step"] == version, (model, version)
+
+    for it in range(version, target):
+        s = rabit.allreduce(np.full(8, float(rank + 1)), rabit.SUM)
+        np.testing.assert_allclose(s, np.full(8, world * (world + 1) / 2))
+        model["step"] = it + 1
+        rabit.checkpoint(model)
+        assert rabit.version_number() == it + 1, \
+            f"version {rabit.version_number()} after checkpoint {it + 1}"
+
+    rabit.tracker_print(f"durable_worker rank {rank}/{world} reached "
+                        f"v{rabit.version_number()} OK")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -19,6 +19,13 @@ this implements the highest-signal subset with only the stdlib:
   ``telemetry.span(...)`` or ``telemetry.trace_annotation(...)`` call —
   an uninstrumented hot path silently disappears from traces, fleet
   tables, and the dispatch accounting.
+- **unretried control-plane sockets** (R001, repo-specific): raw
+  ``socket.socket(...)`` / ``socket.create_connection(...)`` calls
+  inside ``rabit_tpu/`` must go through ``utils/retry.py``
+  (``connect_with_retry``) so transient tracker restarts and chaos
+  blackout windows degrade into logged backoff instead of one-shot
+  failures. Servers/acceptors and the fault injector itself are
+  allowlisted (R001_ALLOWED); ``# noqa: R001`` exempts a line.
 
 ``scripts/run_tests.sh`` prefers ``ruff check`` when installed; this is
 the fallback so the tier never silently no-ops. Exit 0 clean, 1 with
@@ -54,6 +61,48 @@ SPAN_REQUIRED = {
 }
 
 _SPAN_CALL_NAMES = {"span", "trace_annotation"}
+
+# R001: files allowed to construct sockets directly. Listeners/servers
+# (which accept rather than connect), the retry module itself, and the
+# chaos injector (whose whole point is raw socket manipulation).
+R001_ALLOWED = {
+    os.path.join("rabit_tpu", "utils", "retry.py"),
+    os.path.join("rabit_tpu", "tracker", "tracker.py"),
+    os.path.join("rabit_tpu", "chaos", "proxy.py"),
+    os.path.join("rabit_tpu", "chaos", "__main__.py"),
+}
+
+_R001_CALLS = {"socket", "create_connection"}
+
+
+def _r001_issues(rel, tree, src):
+    """Flag raw socket construction in rabit_tpu/ outside the allowlist
+    (``# noqa: R001`` on the line exempts it)."""
+    if not rel.startswith("rabit_tpu" + os.sep) or rel in R001_ALLOWED:
+        return []
+    exempt = set()
+    for i, line in enumerate(src.splitlines(), 1):
+        if "# noqa" in line:
+            tail = line.split("# noqa", 1)[1].strip()
+            if not tail.startswith(":") or "R001" in tail:
+                exempt.add(i)
+    issues = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _R001_CALLS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "socket"):
+            continue
+        if node.lineno in exempt:
+            continue
+        issues.append((
+            rel, node.lineno, "R001",
+            f"raw socket.{f.attr}() in control-plane code — use "
+            "rabit_tpu.utils.retry.connect_with_retry (or add the file "
+            "to R001_ALLOWED if it is a server/injector)"))
+    return issues
 
 
 def _has_span_call(fn_node) -> bool:
@@ -157,6 +206,7 @@ def check_file(path: str):
                                       if alias.asname else "")
                 issues.append((rel, node.lineno, "F401",
                                f"'{shown}' imported but unused"))
+    issues.extend(_r001_issues(rel, tree, src))
     required = SPAN_REQUIRED.get(rel)
     if required:
         seen = set()

@@ -72,7 +72,20 @@ def render_counters(doc):
     title = (f"Telemetry summary — {who}, {doc.get('recorded', 0)} "
              f"span(s) recorded, {doc.get('dropped', 0)} dropped "
              f"({doc.get('timestamp_utc', '')})")
-    return title + "\n\n" + _md_table(head, rows)
+    out = title + "\n\n" + _md_table(head, rows)
+    # recovery sub-table: watchdog expiries, link resets, epoch
+    # advances, world re-formations, cold restarts — the at-a-glance
+    # answer to "did this run survive anything, and what did it cost"
+    rec = [c for c in doc.get("counters", [])
+           if (c.get("provenance") or "") == "recovery"]
+    if rec:
+        rrows = [(c["name"], c["op"] or "-", c["count"],
+                  _fmt_bytes(c["bytes"]), _fmt_s(c["total_s"]),
+                  _fmt_s(c["max_s"])) for c in rec]
+        out += ("\n\nRecovery events ({} kind(s))\n\n".format(len(rec))
+                + _md_table(("event", "op", "count", "bytes", "total",
+                             "max"), rrows))
+    return out
 
 
 def render_trace(doc):
