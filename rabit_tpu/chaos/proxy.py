@@ -164,6 +164,16 @@ class ChaosProxy:
     def _event(self, kind: str, conn_index: int) -> None:
         with self._lock:
             self.events.append((self.elapsed(), kind, conn_index))
+        # telemetry is stdlib-only, so the chaos layer may lean on it:
+        # every injected fault leaves a counter (fleet tables show how
+        # much chaos a run actually absorbed) and a flight-recorder
+        # breadcrumb (crash bundles show what was injected just before)
+        from .. import telemetry
+        from ..telemetry import flight
+        telemetry.count(f"chaos.{kind}", op=self.name, provenance="chaos")
+        flight.note(f"chaos.{kind}",
+                    f"{self.name} conn#{conn_index} -> "
+                    f"{self.upstream[0]}:{self.upstream[1]}")
         print(f"[{self.name}] t={self.elapsed():.2f}s inject {kind} "
               f"conn#{conn_index} -> {self.upstream[0]}:{self.upstream[1]}",
               file=sys.stderr, flush=True)

@@ -308,6 +308,10 @@ class XlaDataPlane:
             # how many collectives escalated into the recovery path
             telemetry.count("recovery.link_reset", op="dataplane",
                             provenance="recovery")
+            from ..telemetry import flight
+            flight.note("link_reset",
+                        f"rank {self._rank} epoch {epoch}: "
+                        f"{type(e).__name__}: {e}")
             try:
                 self._teardown()
             except Exception:  # pragma: no cover - best-effort
@@ -329,7 +333,8 @@ class XlaDataPlane:
             "dataplane.allreduce", nbytes=buf.nbytes,
             op=OP_NAMES.get(op, str(op)), method=self._method,
             wire_requested=os.environ.get("RABIT_DATAPLANE_WIRE", "")
-            or "off")
+            or "off",
+            round=telemetry.collective_round("dataplane.allreduce"))
         # 64-bit payloads: without x64 device_put truncates to 32 bits
         ctx = jax.enable_x64(True) if buf.dtype.itemsize == 8 \
             else contextlib.nullcontext()

@@ -141,6 +141,10 @@ class NativeEngine(Engine):
         # restarts at 0 on a cold restart while the durable store keeps
         # counting, so the app-visible version_number never goes backward
         self._version_offset = 0
+        # live observability plane (both off by default):
+        # rabit_metrics_port HTTP endpoint + rabit_flight_dir recorder
+        self._metrics_server = None
+        self._flight = None
 
     def _cache_key(self, site: str, size: int) -> bytes:
         """Deterministic replay key: caller site + payload size + an
@@ -210,6 +214,11 @@ class NativeEngine(Engine):
             argv.append("rabit_dataplane=xla")
         arr = (ctypes.c_char_p * len(argv))(*[a.encode() for a in argv])
         self._watchdog = Watchdog.from_config(cfg)
+        # flight recorder arms BEFORE the guarded bootstrap: a hung
+        # rendezvous escalated to the grace abort must still leave a
+        # bundle (rank is unknown yet; stamped after init succeeds)
+        from ..telemetry import flight as _flight
+        self._flight = _flight.FlightRecorder.from_config(cfg, rank=-1)
         # bootstrap is a guarded phase too: a tracker that accepted the
         # connection but never completes assignment would otherwise
         # hang the worker forever with no error to react to
@@ -218,6 +227,7 @@ class NativeEngine(Engine):
         log.set_debug(cfg.get_bool("rabit_debug"))
         log.set_identity(self.rank, self.world_size)
         telemetry.configure(cfg)
+        self._start_live_plane(cfg)
         ckpt_dir = cfg.get("rabit_ckpt_dir")
         if ckpt_dir:
             self._store = ckpt_store.CheckpointStore(
@@ -240,6 +250,42 @@ class NativeEngine(Engine):
                 "set_dataplane")
         elif kind not in (None, "", "xla", "none"):
             raise ValueError(f"unknown rabit_dataplane {kind!r}")
+
+    def _start_live_plane(self, cfg) -> None:
+        """Live observability: per-rank metrics endpoint, off unless
+        configured. The flight recorder armed pre-bootstrap; now that
+        the rank is known, stamp it into future bundles."""
+        if self._flight is not None:
+            self._flight.rank = self.rank
+        if "rabit_metrics_port" not in cfg:
+            return
+        from ..telemetry import live as _live
+        try:
+            self._metrics_server = _live.start_rank_server(
+                cfg.get_int("rabit_metrics_port", 0), self.rank,
+                self.world_size, gauges_fn=self._live_gauges)
+        except OSError as e:
+            log.log_warn("metrics endpoint failed to start: %s", e)
+            return
+        if self.is_distributed:
+            # the C++ side composes the start handshake; the Python
+            # side announces its endpoint right after, over the same
+            # rendezvous (best-effort, like the metrics shipment)
+            _live.announce_endpoint(self._metrics_server.host,
+                                    self._metrics_server.port, self.rank)
+
+    def _live_gauges(self):
+        """Watchdog/recovery gauges served on /metrics next to the
+        recorder counters (recovery *events* are counter rows already;
+        these are the current-state reads)."""
+        return [
+            ("rabit_watchdog_expired_total",
+             "Watchdog deadline expiries in this process.", "counter",
+             [({}, self._watchdog.expired_total)]),
+            ("rabit_world_epoch",
+             "Tracker link-registration epoch (advances on recovery).",
+             "gauge", [({}, int(self._lib.RbtWorldEpoch()))]),
+        ]
 
     @property
     def world_epoch(self) -> int:
@@ -267,6 +313,12 @@ class NativeEngine(Engine):
         self._dataplane.on_world_reformed = fn
 
     def shutdown(self) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        if self._flight is not None:
+            self._flight.uninstall()
+            self._flight = None
         if self._dataplane is not None:
             # reference-dropping teardown: no disconnect RPCs, so no
             # ordering between ranks is needed (see dataplane.py)
@@ -306,7 +358,9 @@ class NativeEngine(Engine):
                                   on_expire=self._on_stall), \
                 telemetry.span("engine.allreduce", nbytes=buf.nbytes,
                                op=OP_NAMES.get(op, str(op)),
-                               method="native"):
+                               method="native",
+                               round=telemetry.collective_round(
+                                   "engine.allreduce")):
             rc = self._lib.RbtAllreduceEx(
                 buf.ctypes.data_as(ctypes.c_void_p), buf.size, dtype_enum,
                 op, cb, None, cache_key)
@@ -334,7 +388,9 @@ class NativeEngine(Engine):
             with self._watchdog.guard("engine.broadcast", nbytes=n,
                                       on_expire=self._on_stall), \
                     telemetry.span("engine.broadcast", nbytes=n,
-                                   method="native", root=root):
+                                   method="native", root=root,
+                                   round=telemetry.collective_round(
+                                       "engine.broadcast")):
                 rc = self._lib.RbtBroadcastEx(
                     ctypes.cast(payload, ctypes.c_void_p), n, root,
                     self._cache_key(site + "/payload", n))

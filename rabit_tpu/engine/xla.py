@@ -61,6 +61,9 @@ class XlaEngine(Engine):
         self._debug = False
         self._watchdog = Watchdog()  # disabled until init reads config
         self._store: Optional[ckpt_store.CheckpointStore] = None
+        # live observability plane (off by default, see engine/native.py)
+        self._metrics_server = None
+        self._flight = None
 
     def init(self, args: List[str]) -> None:
         import jax
@@ -112,6 +115,7 @@ class XlaEngine(Engine):
         log.set_identity(self._rank, self._world)
         telemetry.configure(cfg)
         self._watchdog = Watchdog.from_config(cfg)
+        self._start_live_plane(cfg)
         ckpt_dir = cfg.get("rabit_ckpt_dir")
         if ckpt_dir:
             self._store = ckpt_store.CheckpointStore(
@@ -132,7 +136,40 @@ class XlaEngine(Engine):
         devs = [reps[i] for i in sorted(reps)]
         return Mesh(np.array(devs), ("proc",))
 
+    def _start_live_plane(self, cfg) -> None:
+        """Per-rank metrics endpoint + flight recorder (see
+        engine/native.py — same knobs, same defaults-off contract)."""
+        from ..telemetry import flight as _flight
+        self._flight = _flight.FlightRecorder.from_config(cfg,
+                                                          rank=self._rank)
+        if "rabit_metrics_port" not in cfg:
+            return
+        from ..telemetry import live as _live
+        try:
+            self._metrics_server = _live.start_rank_server(
+                cfg.get_int("rabit_metrics_port", 0), self._rank,
+                self._world, gauges_fn=self._live_gauges)
+        except OSError as e:
+            log.log_warn("metrics endpoint failed to start: %s", e)
+            return
+        if self._world > 1:
+            _live.announce_endpoint(self._metrics_server.host,
+                                    self._metrics_server.port, self._rank)
+
+    def _live_gauges(self):
+        return [
+            ("rabit_watchdog_expired_total",
+             "Watchdog deadline expiries in this process.", "counter",
+             [({}, self._watchdog.expired_total)]),
+        ]
+
     def shutdown(self) -> None:
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        if self._flight is not None:
+            self._flight.uninstall()
+            self._flight = None
         telemetry.export_at_shutdown(self._rank, self._world)
 
     # -- collectives ------------------------------------------------------
@@ -160,7 +197,9 @@ class XlaEngine(Engine):
         mesh = self._mesh
         sp = telemetry.span("engine.allreduce", nbytes=buf.nbytes,
                             op=OP_NAMES.get(op, str(op)), method=method,
-                            wire=wire)
+                            wire=wire,
+                            round=telemetry.collective_round(
+                                "engine.allreduce"))
         # 64-bit payloads: without x64, device_put silently truncates
         # int64/float64 to 32 bits; scope-enable it for this reduction
         # (jax.enable_x64 is the >=0.9 spelling; older jax has the same
@@ -202,7 +241,9 @@ class XlaEngine(Engine):
         payload = np.zeros(size, dtype=np.uint8)
         if self._rank == root:
             payload[:] = np.frombuffer(data, dtype=np.uint8)
-        with telemetry.span("engine.broadcast", nbytes=size, root=root):
+        with telemetry.span("engine.broadcast", nbytes=size, root=root,
+                            round=telemetry.collective_round(
+                                "engine.broadcast")):
             self._device_bcast(payload, root)
         return payload.tobytes()
 

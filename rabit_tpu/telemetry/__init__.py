@@ -69,6 +69,13 @@ def count(name: str, nbytes: int = 0, op=None, method=None, wire=None,
                provenance=provenance)
 
 
+def collective_round(name: str) -> int:
+    """Per-name collective round id (1-based; 0 when disabled) —
+    stamped into spans so cross-rank stitching can match the same
+    collective across ranks (telemetry/crossrank.py)."""
+    return _REC.next_round(name)
+
+
 def record_dispatch(n: int, itemsize: int, op: str, method: str,
                     wire: Optional[str], provenance: str) -> None:
     """One ``dispatch.resolve()`` outcome: which schedule/wire an

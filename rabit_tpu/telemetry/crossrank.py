@@ -1,0 +1,187 @@
+"""Cross-rank collective tracing: stitch one collective across ranks.
+
+Every engine stamps a per-collective **round id** into its spans (a
+per-name sequence number — engine ordering is deterministic across
+ranks, so round N on rank A and round N on rank B are the same
+collective), and every exported artifact carries the recorder's
+wall-clock anchor (``t_base_unix``), so a span's relative ``t0``
+becomes a comparable arrival timestamp. From per-rank artifacts this
+module computes, per round:
+
+- **arrival skew**: last arrival minus first arrival — the imbalance
+  cost every other rank pays waiting (arXiv:1804.05349's dominant
+  real-world allreduce cost);
+- **straggler**: the rank that arrived last;
+- **critical path**: skew plus the straggler's own span duration — the
+  wall-clock floor of that collective as actually experienced.
+
+Inputs: ``telemetry_trace/v1`` documents, ``flight_record/v1``
+bundles, or raw recorder snapshots (tests build synthetic ones).
+Stdlib-only: the tracker and tools import this without jax.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from .schema import matches
+
+# Span names that represent one cross-rank collective occurrence.
+ROUND_SPAN_NAMES = ("engine.allreduce", "engine.broadcast",
+                    "dataplane.allreduce")
+
+
+def _records_from_spans(spans: Iterable[dict],
+                        t_base_unix: float) -> List[dict]:
+    out = []
+    for s in spans:
+        rnd = (s.get("attrs") or {}).get("round")
+        if rnd is None:
+            continue
+        out.append({"round": int(rnd), "name": s["name"],
+                    "t_wall": t_base_unix + float(s.get("t0", 0.0)),
+                    "dur": float(s.get("dur", 0.0))})
+    return out
+
+
+def _records_from_trace(doc: dict) -> List[dict]:
+    base = float(doc.get("t_base_unix", 0.0))
+    out = []
+    for ev in doc.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        rnd = (ev.get("args") or {}).get("round")
+        if rnd is None:
+            continue
+        out.append({"round": int(rnd), "name": ev["name"],
+                    "t_wall": base + float(ev.get("ts", 0.0)) / 1e6,
+                    "dur": float(ev.get("dur", 0.0)) / 1e6})
+    return out
+
+
+def extract_rounds(doc: dict) -> Optional[tuple]:
+    """``(rank, [records])`` from any round-carrying artifact, or None
+    when the document has no rounds to contribute."""
+    if matches(doc, "telemetry_trace"):
+        rank = next((ev.get("pid", 0) for ev in doc.get("traceEvents", [])),
+                    0)
+        recs = _records_from_trace(doc)
+    elif matches(doc, "flight_record"):
+        rank = doc.get("rank", 0)
+        telem = doc.get("telemetry") or {}
+        recs = _records_from_spans(telem.get("spans", []),
+                                   float(doc.get("t_base_unix", 0.0)))
+    elif "spans" in doc:  # raw recorder snapshot (tests, tools)
+        rank = doc.get("rank", 0)
+        recs = _records_from_spans(doc.get("spans", []),
+                                   float(doc.get("t_base_unix", 0.0)))
+    else:
+        return None
+    return (rank, recs) if recs else None
+
+
+def stitch_rounds(per_rank: Dict[int, List[dict]]) -> List[dict]:
+    """Merge per-rank round records into per-round rows. Only rounds
+    observed on at least two ranks are comparable (a round seen on one
+    rank alone has no skew); they are kept with ``skew_s=None`` so a
+    report can still show them."""
+    rounds: Dict[tuple, dict] = {}
+    for rank, recs in per_rank.items():
+        for r in recs:
+            key = (r["name"], r["round"])
+            row = rounds.setdefault(key, {"name": r["name"],
+                                          "round": r["round"],
+                                          "arrivals": {}, "durs": {}})
+            row["arrivals"][rank] = r["t_wall"]
+            row["durs"][rank] = r["dur"]
+    out = []
+    for key in sorted(rounds, key=lambda k: (k[0], k[1])):
+        row = rounds[key]
+        arr = row["arrivals"]
+        if len(arr) >= 2:
+            first_rank = min(arr, key=lambda r: arr[r])
+            straggler = max(arr, key=lambda r: arr[r])
+            skew = arr[straggler] - arr[first_rank]
+            row["first_rank"] = first_rank
+            row["straggler_rank"] = straggler
+            row["skew_s"] = skew
+            row["critical_path_s"] = skew + row["durs"][straggler]
+        else:
+            row["first_rank"] = row["straggler_rank"] = None
+            row["skew_s"] = row["critical_path_s"] = None
+        out.append(row)
+    return out
+
+
+def skew_table(rounds: List[dict]) -> List[dict]:
+    """Per-rank attribution over stitched rounds: how often each rank
+    was the straggler and how much skew it caused while lagging."""
+    per: Dict[int, dict] = {}
+    for row in rounds:
+        for rank in row["arrivals"]:
+            per.setdefault(rank, {"rank": rank, "rounds": 0,
+                                  "straggler_rounds": 0,
+                                  "skew_caused_s": 0.0,
+                                  "worst_skew_s": 0.0})
+            per[rank]["rounds"] += 1
+        if row["skew_s"] is None:
+            continue
+        lag = per[row["straggler_rank"]]
+        lag["straggler_rounds"] += 1
+        lag["skew_caused_s"] += row["skew_s"]
+        lag["worst_skew_s"] = max(lag["worst_skew_s"], row["skew_s"])
+    return [per[r] for r in sorted(per)]
+
+
+def stitch_documents(docs: Iterable[dict]) -> List[dict]:
+    """Convenience: stitch any mix of round-carrying artifacts. Ranks
+    colliding across documents keep the last document's records (one
+    artifact per rank is the expected shape)."""
+    per_rank: Dict[int, List[dict]] = {}
+    for doc in docs:
+        got = extract_rounds(doc)
+        if got is not None:
+            per_rank[got[0]] = got[1]
+    return stitch_rounds(per_rank)
+
+
+# -- live straggler snapshot (counter-only inputs) -------------------------
+
+_COLLECTIVE_PREFIXES = ("engine.", "dataplane.")
+
+
+def straggler_snapshot(summaries: Dict[str, dict]) -> dict:
+    """Who is behind, from live-polled ``telemetry_summary`` docs
+    (counters only — spans never ride the poll path). A lagging rank
+    has completed the FEWEST collectives (it is behind the others'
+    round sequence); ties break toward the SMALLEST in-collective busy
+    time: synchronizing collectives complete in lockstep, and the rank
+    everyone waits for is the one that arrives last and leaves at once,
+    while the waiters burn their time blocked inside the collective.
+    Returns per-rank rows plus the named laggard; the tracker serves
+    this as ``/straggler`` and as gauges on its ``/metrics``."""
+    rows = []
+    for tid in sorted(summaries, key=str):
+        doc = summaries[tid]
+        if not matches(doc, "telemetry_summary"):
+            continue
+        count = busy = maxs = 0.0
+        for c in doc.get("counters", []):
+            if not str(c.get("name", "")).startswith(_COLLECTIVE_PREFIXES):
+                continue
+            count += c.get("count", 0)
+            busy += c.get("total_s", 0.0)
+            maxs = max(maxs, c.get("max_s", 0.0))
+        rows.append({"task_id": str(tid), "rank": doc.get("rank", -1),
+                     "collectives": int(count), "busy_s": busy,
+                     "max_s": maxs})
+    snap = {"ranks": rows, "lagging_rank": None, "lag_collectives": 0,
+            "busy_skew_s": 0.0}
+    if len(rows) >= 2:
+        lead = max(r["collectives"] for r in rows)
+        lag = min(rows, key=lambda r: (r["collectives"], r["busy_s"]))
+        snap["lagging_rank"] = lag["rank"]
+        snap["lag_collectives"] = lead - lag["collectives"]
+        busys = [r["busy_s"] for r in rows]
+        snap["busy_skew_s"] = max(busys) - min(busys)
+    return snap

@@ -29,6 +29,7 @@ def build_summary(snapshot: dict, rank: int = -1,
     doc["recorded"] = snapshot["recorded"]
     doc["dropped"] = snapshot["dropped"]
     doc["capacity"] = snapshot["capacity"]
+    doc["t_base_unix"] = snapshot.get("t_base_unix", 0.0)
     doc["counters"] = snapshot["counters"]
     return doc
 
@@ -70,6 +71,9 @@ def build_chrome_trace(snapshot: dict, rank: int = -1) -> dict:
              "args": {"name": f"rabit rank {pid}"}}]
     doc = make_header(TRACE_KIND)
     doc["displayTimeUnit"] = "ms"
+    # wall-clock anchor for ts=0: lets per-rank traces be stitched on
+    # absolute time (cross-rank round skew, telemetry/crossrank.py)
+    doc["t_base_unix"] = snapshot.get("t_base_unix", 0.0)
     doc["traceEvents"] = meta + events
     return doc
 

@@ -1,0 +1,155 @@
+"""Prometheus text-exposition rendering for recorder summaries.
+
+The live metrics endpoints (``telemetry/live.py``, the tracker's
+fleet-merged endpoint) serve recorder state in the Prometheus text
+format (version 0.0.4) so any off-the-shelf scraper — or plain curl —
+can watch a run mid-flight. Stdlib-only on purpose: no
+prometheus_client dependency, and the tracker renders without jax.
+
+Mapping from recorder counters (one row per
+``(name, op, method, wire, bucket, provenance)`` key):
+
+- ``rabit_collective_total``           count        (counter)
+- ``rabit_collective_bytes_total``     bytes        (counter)
+- ``rabit_collective_seconds_total``   total_s      (counter)
+- ``rabit_collective_max_seconds``     max_s        (gauge)
+- ``rabit_collective_duration_seconds`` the log2-µs histogram as a
+  native Prometheus histogram: recorder bucket k covers
+  ``(2^(k-1), 2^k]`` µs, so its cumulative ``le`` bound is
+  ``2^k * 1e-6`` seconds; ``_sum``/``_count`` come from the exact
+  counter row.
+
+Recorder occupancy (recorded / dropped / capacity / enabled) is
+exported under ``rabit_telemetry_*`` per source, and callers may append
+arbitrary extra gauges (watchdog expiries, poll counts, straggler
+snapshots).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Tuple
+
+_LABEL_FIELDS = ("name", "op", "method", "wire", "bucket", "provenance")
+
+# extra gauge spec: (metric_name, help_text, type, [(labels, value)])
+GaugeSpec = Tuple[str, str, str, List[Tuple[Dict[str, str], float]]]
+
+
+def escape_label_value(v: str) -> str:
+    """Label-value escaping per the exposition format: backslash,
+    double-quote, and newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _labels(pairs: Dict[str, str]) -> str:
+    if not pairs:
+        return ""
+    inner = ",".join(f'{k}="{escape_label_value(v)}"'
+                     for k, v in pairs.items())
+    return "{" + inner + "}"
+
+
+def _fmt_value(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+class _Family:
+    """One metric family: emits HELP/TYPE once, then every sample."""
+
+    def __init__(self, name: str, help_text: str, mtype: str):
+        self.name = name
+        self.help = help_text
+        self.type = mtype
+        self.samples: List[str] = []
+
+    def add(self, labels: Dict[str, str], value, suffix: str = "") -> None:
+        self.samples.append(
+            f"{self.name}{suffix}{_labels(labels)} {_fmt_value(value)}")
+
+    def lines(self) -> List[str]:
+        if not self.samples:
+            return []
+        return [f"# HELP {self.name} {self.help}",
+                f"# TYPE {self.name} {self.type}"] + self.samples
+
+
+def _counter_labels(row: dict, base: Dict[str, str]) -> Dict[str, str]:
+    labels = dict(base)
+    for f in _LABEL_FIELDS:
+        labels[f] = row.get(f, "") or ""
+    return labels
+
+
+def render_prometheus(sources: Iterable[Tuple[Dict[str, str], dict]],
+                      gauges: Iterable[GaugeSpec] = ()) -> str:
+    """Render ``[(base_labels, summary_doc)]`` plus extra gauges into
+    one exposition document. A worker passes one source (its own
+    summary, labelled with its rank); the tracker passes one source per
+    polled rank so a single scrape carries per-rank counters."""
+    fams = {
+        "count": _Family("rabit_collective_total",
+                         "Events per (name,op,method,wire,bucket,"
+                         "provenance) key.", "counter"),
+        "bytes": _Family("rabit_collective_bytes_total",
+                         "Payload bytes per counter key.", "counter"),
+        "secs": _Family("rabit_collective_seconds_total",
+                        "Busy seconds per counter key.", "counter"),
+        "max": _Family("rabit_collective_max_seconds",
+                       "Slowest single event per counter key.", "gauge"),
+        "hist": _Family("rabit_collective_duration_seconds",
+                        "Event duration distribution (log2-microsecond "
+                        "recorder buckets).", "histogram"),
+        "recorded": _Family("rabit_telemetry_recorded_total",
+                            "Spans recorded since reset.", "counter"),
+        "dropped": _Family("rabit_telemetry_dropped_total",
+                           "Spans overwritten in the ring buffer.",
+                           "counter"),
+        "capacity": _Family("rabit_telemetry_buffer_capacity",
+                            "Ring-buffer capacity in spans.", "gauge"),
+        "enabled": _Family("rabit_telemetry_enabled",
+                           "1 when the recorder is enabled.", "gauge"),
+    }
+    for base, doc in sources:
+        base = dict(base or {})
+        fams["recorded"].add(base, int(doc.get("recorded", 0)))
+        fams["dropped"].add(base, int(doc.get("dropped", 0)))
+        if "capacity" in doc:
+            fams["capacity"].add(base, int(doc["capacity"]))
+        if "enabled" in doc:
+            fams["enabled"].add(base, bool(doc["enabled"]))
+        for row in doc.get("counters", []):
+            labels = _counter_labels(row, base)
+            fams["count"].add(labels, int(row.get("count", 0)))
+            fams["bytes"].add(labels, int(row.get("bytes", 0)))
+            fams["secs"].add(labels, float(row.get("total_s", 0.0)))
+            fams["max"].add(labels, float(row.get("max_s", 0.0)))
+            hist = row.get("hist_log2_us") or {}
+            if hist:
+                cum = 0
+                for k, n in sorted((int(b), n) for b, n in hist.items()):
+                    cum += n
+                    le = dict(labels)
+                    le["le"] = repr((1 << k) * 1e-6)
+                    fams["hist"].add(le, cum, suffix="_bucket")
+                inf = dict(labels)
+                inf["le"] = "+Inf"
+                fams["hist"].add(inf, cum, suffix="_bucket")
+                fams["hist"].add(labels, float(row.get("total_s", 0.0)),
+                                 suffix="_sum")
+                fams["hist"].add(labels, cum, suffix="_count")
+    lines: List[str] = []
+    order = ("count", "bytes", "secs", "max", "hist", "recorded",
+             "dropped", "capacity", "enabled")
+    for key in order:
+        lines.extend(fams[key].lines())
+    for name, help_text, mtype, samples in gauges:
+        fam = _Family(name, help_text, mtype)
+        for labels, value in samples:
+            fam.add(labels, value)
+        lines.extend(fam.lines())
+    return "\n".join(lines) + "\n"

@@ -127,7 +127,13 @@ class Recorder:
             self.recorded = 0       # spans ever recorded
             self.dropped = 0        # spans overwritten in the ring
             self._counters: dict = {}
+            self._rounds: dict = {}
             self.t_base = time.perf_counter()
+            # wall-clock anchor for the same instant as t_base: spans'
+            # relative t0 + t_base_unix gives an absolute arrival time
+            # comparable ACROSS ranks (cross-rank round stitching,
+            # telemetry/crossrank.py)
+            self.t_base_unix = time.time()
 
     # -- recording --------------------------------------------------------
     def span(self, name: str, nbytes: int = 0, op=None, method=None,
@@ -159,6 +165,19 @@ class Recorder:
                size_bucket(nbytes), provenance)
         with self._lock:
             self._bump(key, nbytes, None)
+
+    def next_round(self, name: str) -> int:
+        """Per-name collective sequence number (1-based). Engine call
+        order is deterministic across ranks, so the same round id on
+        two ranks names the same collective — the cross-rank stitching
+        key (telemetry/crossrank.py). Advances only while enabled, so
+        uniformly-configured ranks stay in step; returns 0 disabled."""
+        if not self.enabled:
+            return 0
+        with self._lock:
+            n = self._rounds.get(name, 0) + 1
+            self._rounds[name] = n
+            return n
 
     def _record(self, name, t0_abs, dur_s, nbytes, op, method, wire,
                 provenance, attrs) -> None:
@@ -231,5 +250,6 @@ class Recorder:
                     "capacity": self.capacity,
                     "recorded": self.recorded,
                     "dropped": self.dropped,
+                    "t_base_unix": self.t_base_unix,
                     "spans": [dict(s) for s in spans],
                     "counters": counters}

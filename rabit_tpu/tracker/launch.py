@@ -165,6 +165,10 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
             # metrics command) — how cluster tests assert that recovery
             # spans/counters actually fired on the workers
             stats["fleet_metrics"] = tracker.merged_metrics()
+            # live observability plane: endpoints announced, poll
+            # sweeps completed, and the last straggler snapshot —
+            # captured BEFORE tracker.stop() tears the poller down
+            stats["live"] = tracker.live_stats()
         for p in procs.values():
             if p.poll() is None:
                 p.kill()

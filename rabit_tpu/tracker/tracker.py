@@ -21,6 +21,10 @@ Wire protocol (binary, little-endian, length-prefixed strings):
     metrics:       + payload str (a rabit_tpu.telemetry_summary/v1 JSON
                    document; the tracker stores the latest per task_id
                    and prints the merged fleet table at end of run)
+    endpoint:      + payload str (JSON {"host","port","rank"}: where
+                   that worker's live /metrics endpoint listens; the
+                   tracker's poller scrapes it on an interval while the
+                   run is live — see telemetry/live.py)
   tracker -> worker (start/recover): rank u32, world u32, epoch u32,
     coord_host str, coord_port u32 (this epoch's tracker-hosted device
     -world coordination service; empty/0 when coordinator hosting is
@@ -132,7 +136,8 @@ class Tracker:
     def __init__(self, nworkers: int, host: str = "127.0.0.1", port: int = 0,
                  coordinator: bool = False,
                  ready_timeout: Optional[float] = None,
-                 link_rewrite=None):
+                 link_rewrite=None,
+                 metrics_port: Optional[int] = None):
         self.nworkers = nworkers
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -177,11 +182,28 @@ class Tracker:
         # comm.cc ReconnectLinks)
         self._services: List[Tuple[int, object]] = []
         self._coord_addr: Tuple[str, int] = ("", 0)
+        # live observability plane (off unless rabit_metrics_port /
+        # RABIT_METRICS_PORT is configured): workers announce their
+        # /metrics endpoints via the ``endpoint`` command; a poller
+        # thread scrapes each rank's /summary on an interval and feeds
+        # the SAME per-task metrics dict the end-of-run merge uses, so
+        # the tracker's own /metrics serves a mid-run fleet view
+        if metrics_port is None:
+            raw = os.environ.get("RABIT_METRICS_PORT")
+            metrics_port = int(raw) if raw not in (None, "") else None
+        self._metrics_port = metrics_port
+        self._endpoints: Dict[str, dict] = {}   # task_id -> {host,port,rank}
+        self._metrics_server = None
+        self._poll_thread: Optional[threading.Thread] = None
+        self._poll_stop = threading.Event()
+        self._poll_count = 0
+        self._last_straggler: Optional[dict] = None
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Tracker":
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+        self._start_live_plane()
         return self
 
     def join(self, timeout: Optional[float] = None) -> bool:
@@ -189,6 +211,10 @@ class Tracker:
 
     def stop(self) -> None:
         self._done.set()
+        self._poll_stop.set()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
         try:
             self.sock.close()
         except OSError:
@@ -273,6 +299,121 @@ class Tracker:
             return None
         return merge_summaries(snap)
 
+    # -- live observability plane -----------------------------------------
+    def _start_live_plane(self) -> None:
+        """Fleet metrics endpoint + per-rank poller (off unless a
+        metrics port was configured). Failure to bind is a warning, not
+        a run killer — observability must never block rendezvous."""
+        if self._metrics_port is None:
+            return
+        from ..telemetry import live
+        try:
+            self._metrics_server = live.MetricsServer(
+                port=self._metrics_port,
+                sources_fn=self._metric_sources,
+                summary_fn=lambda: self.merged_metrics() or {},
+                gauges_fn=self._live_gauges,
+                identity={"role": "tracker", "nworkers": self.nworkers},
+                routes={"/straggler": self._straggler_doc},
+            ).start()
+        except OSError as e:
+            print(f"[tracker] metrics server failed to bind port "
+                  f"{self._metrics_port}: {e}", file=sys.stderr, flush=True)
+            return
+        # port 0 auto-assigns; without this line the endpoint would be
+        # undiscoverable from the launch CLI
+        print(f"[tracker] live metrics on "
+              f"{self._metrics_server.host}:{self._metrics_server.port}",
+              file=sys.stderr, flush=True)
+        self._poll_stop.clear()
+        self._poll_thread = threading.Thread(
+            target=self._poll_loop, name="rabit-tracker-poll", daemon=True)
+        self._poll_thread.start()
+
+    def _metric_sources(self) -> list:
+        """One Prometheus source per polled rank: the per-rank summary
+        labelled with its rank, so one tracker scrape shows every
+        rank's collective counters side by side."""
+        with self._lock:
+            docs = list(self._metrics.values())
+        return [({"rank": str(doc.get("rank", -1))}, doc) for doc in docs]
+
+    def _live_gauges(self) -> list:
+        with self._lock:
+            nend = len(self._endpoints)
+            polls = self._poll_count
+            strag = self._last_straggler
+        gauges = [
+            ("rabit_tracker_endpoints",
+             "Worker metrics endpoints known to the tracker.",
+             "gauge", [({}, nend)]),
+            ("rabit_tracker_polls_total",
+             "Completed endpoint poll sweeps.", "counter", [({}, polls)]),
+        ]
+        if strag is not None and strag.get("lagging_rank") is not None:
+            gauges.append((
+                "rabit_straggler_lag_collectives",
+                "Collectives the laggard is behind the leader.", "gauge",
+                [({"rank": str(strag["lagging_rank"])},
+                  strag["lag_collectives"])]))
+            gauges.append((
+                "rabit_straggler_busy_skew_seconds",
+                "Spread of per-rank collective busy time.", "gauge",
+                [({}, strag["busy_skew_s"])]))
+        return gauges
+
+    def _straggler_doc(self) -> dict:
+        with self._lock:
+            strag = self._last_straggler
+        return strag if strag is not None else {"ranks": []}
+
+    def _poll_loop(self) -> None:
+        from ..telemetry import crossrank, live
+        interval = live.poll_interval_s()
+        since_snapshot = 0
+        while not self._poll_stop.wait(interval):
+            with self._lock:
+                endpoints = dict(self._endpoints)
+            if not endpoints:
+                continue
+            for tid, ep in endpoints.items():
+                doc = live.scrape_json(ep["host"], ep["port"])
+                if doc is not None:
+                    with self._lock:
+                        self._metrics[tid] = doc
+            with self._lock:
+                summaries = dict(self._metrics)
+                self._poll_count += 1
+            strag = crossrank.straggler_snapshot(summaries)
+            with self._lock:
+                self._last_straggler = strag
+            # periodic straggler snapshot: one line every ~5 sweeps,
+            # only while someone is actually behind — in the round
+            # sequence, or >1s of accumulated in-collective wait
+            since_snapshot += 1
+            behind = strag.get("lagging_rank") is not None and (
+                strag.get("lag_collectives", 0) > 0
+                or strag.get("busy_skew_s", 0.0) > 1.0)
+            if since_snapshot >= 5 and behind:
+                since_snapshot = 0
+                print(f"[tracker] straggler: rank "
+                      f"{strag['lagging_rank']} is "
+                      f"{strag['lag_collectives']} collectives behind "
+                      f"(busy skew {strag['busy_skew_s']:.3f}s)",
+                      file=sys.stderr, flush=True)
+
+    def live_stats(self) -> dict:
+        """Snapshot of the live plane for launchers and tests."""
+        with self._lock:
+            return {
+                "metrics_addr": (None if self._metrics_server is None
+                                 else list(self._metrics_server.address)),
+                "endpoints": {t: dict(e) for t, e in
+                              self._endpoints.items()},
+                "polls": self._poll_count,
+                "straggler": self._last_straggler,
+            }
+
     def _print_fleet_metrics(self) -> None:
         """End-of-run fleet table — the production replacement for
         eyeballing per-rank TrackerPrint lines. Appended to
@@ -296,7 +437,10 @@ class Tracker:
 
     # -- serving ----------------------------------------------------------
     def _serve(self) -> None:
-        self.sock.settimeout(0.2)
+        try:
+            self.sock.settimeout(0.2)
+        except OSError:  # stop() closed the socket before we started
+            return
         while not self._done.is_set():
             try:
                 conn, _ = self.sock.accept()
@@ -333,6 +477,22 @@ class Tracker:
                     with self._lock:
                         self._metrics[task_id] = doc
                 _send_u32(conn, 1 if isinstance(doc, dict) else 0)
+                conn.close()
+            elif cmd == "endpoint":
+                payload = _recv_str(conn)
+                try:
+                    doc = json.loads(payload)
+                except ValueError:
+                    doc = None
+                ok = (isinstance(doc, dict) and "host" in doc
+                      and "port" in doc)
+                if ok:
+                    with self._lock:
+                        self._endpoints[task_id] = {
+                            "host": str(doc["host"]),
+                            "port": int(doc["port"]),
+                            "rank": int(doc.get("rank", -1))}
+                _send_u32(conn, 1 if ok else 0)
                 conn.close()
             elif cmd == "shutdown":
                 with self._lock:

@@ -43,6 +43,10 @@ ENV_VARS = [
     "RABIT_CKPT_DIR",
     "RABIT_CKPT_KEEP",
     "RABIT_CHAOS",
+    "RABIT_METRICS_PORT",
+    "RABIT_METRICS_POLL_MS",
+    "RABIT_FLIGHT_DIR",
+    "RABIT_FLIGHT_KEEP",
     "RABIT_WORLD_SIZE",
     "RABIT_RANK",
     "rabit_world_size",

@@ -218,6 +218,10 @@ class Watchdog:
                         provenance="recovery")
         telemetry.record_span("watchdog.stall", stalled, nbytes=g.nbytes,
                               op=g.name, provenance="recovery")
+        from ..telemetry import flight
+        flight.note("watchdog_expired",
+                    f"{g.name} stalled {stalled:.1f}s "
+                    f"(deadline {g.deadline_s:.1f}s)")
         log.log_warn("watchdog: %s stalled %.1fs past its %.1fs deadline; "
                      "escalating to link reset%s", g.name, stalled,
                      g.deadline_s,
@@ -230,8 +234,18 @@ class Watchdog:
                              g.name, e)
 
     def _abort(self, g: _Guard) -> None:
+        from .. import telemetry
+        telemetry.count("watchdog.abort", nbytes=g.nbytes, op=g.name,
+                        provenance="recovery")
         log.log_warn(
             "watchdog: %s still stalled after escalation; aborting process "
             "(exit %d) so the launcher respawns and the epoch advances",
             g.name, WATCHDOG_EXIT_CODE)
+        # the flight recorder (if installed) gets the last word before
+        # os._exit: ring buffer, recent events, and every thread's stack
+        # — including the one stalled inside the C++ recv we are about
+        # to kill the process over
+        from ..telemetry import flight
+        flight.trigger("watchdog_abort",
+                       f"{g.name} ({g.nbytes} bytes) stalled past grace")
         self._abort_fn(WATCHDOG_EXIT_CODE)

@@ -14,11 +14,13 @@ cd "$(dirname "$0")/.."
 echo "== tier 0: lint =="
 if command -v ruff >/dev/null 2>&1; then
   ruff check rabit_tpu tools tests examples bench.py setup.py
-  # ruff can't know the repo-specific span-presence contract (T001);
-  # run the stdlib linter for that check either way
+  # ruff can't know the repo-specific span-presence (T001) and
+  # escalation-counter (T002) contracts; run the stdlib linter for
+  # those checks either way
   python tools/lint.py rabit_tpu/parallel/collectives.py \
       rabit_tpu/engine/xla.py rabit_tpu/engine/native.py \
-      rabit_tpu/engine/dataplane.py
+      rabit_tpu/engine/dataplane.py rabit_tpu/utils/watchdog.py \
+      rabit_tpu/chaos/proxy.py
 else
   # containers without ruff fall back to the stdlib-only subset
   python tools/lint.py
@@ -30,6 +32,9 @@ JAX_PLATFORMS=cpu python tools/trace_report.py --smoke \
 
 echo "== tier 0c: chaos smoke (proxy -> injected reset -> retry) =="
 python -m rabit_tpu.chaos --smoke
+
+echo "== tier 0d: live-plane smoke (endpoint -> scrape -> flight) =="
+python -m rabit_tpu.telemetry --smoke
 
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null

@@ -1,0 +1,615 @@
+"""Live observability plane (the observability PR's tentpole #2):
+
+- Prometheus text-exposition rendering — label escaping, cumulative
+  log2-µs histogram buckets, empty recorders, extra gauges;
+- the per-rank HTTP metrics endpoint (``/metrics``, ``/healthz``,
+  ``/summary``) and its scrape helpers;
+- the tracker's ``endpoint`` wire command, the live poller, the
+  fleet-merged ``/metrics``, and the ``/straggler`` snapshot —
+  exercised in-process over the real wire protocol, no native lib;
+- cross-rank round stitching: arrival skew, critical path, straggler
+  attribution, and the counter-only live laggard heuristic;
+- the crash flight recorder: bundle round-trip, keep-pruning,
+  excepthook chaining, and the watchdog grace-abort seam dumping a
+  bundle before exit;
+- the T002 escalation-counter lint contract;
+- ``tools/capture_status.py --live`` and ``tools/trace_report.py``
+  rendering of flight bundles + multi-artifact skew reports;
+- (slow) a real 2-worker native cluster under a chaos partition with
+  the full plane on: live endpoints polled by the tracker, and a
+  hung-bootstrap watchdog abort leaving a renderable flight bundle.
+"""
+
+import importlib.util
+import json
+import os
+import signal
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from rabit_tpu import telemetry
+from rabit_tpu.telemetry import crossrank, flight, live, prom
+from rabit_tpu.telemetry.export import build_summary
+from rabit_tpu.telemetry.recorder import Recorder
+from rabit_tpu.telemetry.schema import matches
+from rabit_tpu.tracker.tracker import MAGIC, Tracker
+from rabit_tpu.utils.config import Config
+from rabit_tpu.utils.watchdog import WATCHDOG_EXIT_CODE, Watchdog
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKERS = os.path.join(ROOT, "tests", "workers")
+LIB = os.path.join(ROOT, "native", "build", "librabit_tpu_core.so")
+
+
+@pytest.fixture
+def telem():
+    telemetry.reset(capacity=256, enabled=True)
+    yield
+    telemetry.reset(enabled=False)
+
+
+def _get(host, port, path, timeout=5.0):
+    with urllib.request.urlopen(f"http://{host}:{port}{path}",
+                                timeout=timeout) as r:
+        return r.headers.get("Content-Type", ""), r.read().decode()
+
+
+# ------------------------------------------------- Prometheus rendering
+
+
+def test_prom_label_escaping():
+    assert prom.escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    doc = {"recorded": 1, "dropped": 0,
+           "counters": [{"name": 'evil"name\\', "op": "", "method": "",
+                         "wire": "", "bucket": "0B", "count": 1,
+                         "bytes": 0, "total_s": 0.0, "max_s": 0.0,
+                         "hist_log2_us": {}}]}
+    text = prom.render_prometheus([({}, doc)])
+    assert 'name="evil\\"name\\\\"' in text
+    # every non-comment line is "name{labels} value"
+    for ln in text.splitlines():
+        if ln and not ln.startswith("#"):
+            assert " " in ln, ln
+
+
+def test_prom_histogram_cumulative_buckets():
+    r = Recorder(capacity=16, enabled=True)
+    # log2-µs buckets: 1.5µs -> k=1 (le 2µs), 3µs & 3.5µs -> k=2 (le 4µs)
+    for dur in (1.5e-6, 3e-6, 3.5e-6):
+        r.record_span("allreduce", dur, nbytes=64, op="sum")
+    text = prom.render_prometheus([({}, build_summary(r.snapshot()))])
+    assert "# TYPE rabit_collective_duration_seconds histogram" in text
+
+    def bucket(le):
+        for ln in text.splitlines():
+            if ln.startswith("rabit_collective_duration_seconds_bucket") \
+                    and f'le="{le}"' in ln:
+                return float(ln.rsplit(None, 1)[1])
+        raise AssertionError(f"no bucket le={le}: {text}")
+    assert bucket(repr(2e-06)) == 1
+    assert bucket(repr(4e-06)) == 3
+    assert bucket("+Inf") == 3
+    assert "rabit_collective_duration_seconds_count" in text
+    assert "rabit_collective_total" in text
+    assert 'op="sum"' in text
+
+
+def test_prom_empty_recorder_and_gauges():
+    r = Recorder(capacity=4, enabled=True)
+    text = prom.render_prometheus(
+        [({"rank": "7"}, build_summary(r.snapshot()))],
+        gauges=[("rabit_custom_gauge", "help.", "gauge",
+                 [({"k": "v"}, 2.5)])])
+    assert 'rabit_telemetry_recorded_total{rank="7"} 0' in text
+    assert "rabit_collective_total{" not in text  # no counters yet
+    assert 'rabit_custom_gauge{k="v"} 2.5' in text
+    assert text.endswith("\n")
+
+
+def test_prom_multi_source_rank_labels():
+    rows = []
+    for rank in (0, 1):
+        r = Recorder(capacity=8, enabled=True)
+        r.count("engine.allreduce", nbytes=1024, op="sum")
+        rows.append(({"rank": str(rank)},
+                     build_summary(r.snapshot(), rank=rank)))
+    text = prom.render_prometheus(rows)
+    assert 'rank="0"' in text and 'rank="1"' in text
+    # HELP/TYPE emitted once per family, not per source
+    assert text.count("# TYPE rabit_collective_total counter") == 1
+
+
+# ------------------------------------------------- rank metrics endpoint
+
+
+def test_rank_server_serves_metrics_health_summary(telem):
+    telemetry.record_span("engine.allreduce", 1e-3, nbytes=1 << 20,
+                          op="sum", method="ring",
+                          round=telemetry.collective_round(
+                              "engine.allreduce"))
+    srv = live.start_rank_server(0, rank=3, world=8)
+    try:
+        ctype, text = _get(srv.host, srv.port, "/metrics")
+        assert "version=0.0.4" in ctype
+        assert 'name="engine.allreduce"' in text
+        assert 'rank="3"' in text
+        _, health = _get(srv.host, srv.port, "/healthz")
+        h = json.loads(health)
+        assert h["ok"] and h["rank"] == 3 and h["world"] == 8
+        assert h["pid"] == os.getpid()
+        _, summary = _get(srv.host, srv.port, "/summary")
+        doc = json.loads(summary)
+        assert matches(doc, "telemetry_summary") and doc["rank"] == 3
+        assert doc["t_base_unix"] > 0
+        with pytest.raises(urllib.error.HTTPError):
+            _get(srv.host, srv.port, "/nope")
+        # scrape helper sees the same doc; bad port returns None
+        assert live.scrape_json(srv.host, srv.port)["rank"] == 3
+    finally:
+        srv.stop()
+    assert live.scrape_json(srv.host, srv.port, timeout=0.5) is None
+
+
+def test_poll_interval_knob(monkeypatch):
+    monkeypatch.delenv("RABIT_METRICS_POLL_MS", raising=False)
+    assert live.poll_interval_s() == pytest.approx(2.0)
+    monkeypatch.setenv("RABIT_METRICS_POLL_MS", "250")
+    assert live.poll_interval_s() == pytest.approx(0.25)
+    monkeypatch.setenv("RABIT_METRICS_POLL_MS", "1")  # floored
+    assert live.poll_interval_s() == pytest.approx(0.05)
+    cfg = Config.from_args(["rabit_metrics_poll_ms=100"])
+    assert live.poll_interval_s(cfg) == pytest.approx(0.1)
+
+
+# ------------------------------- tracker: endpoint cmd + poller + fleet
+
+
+def _send_endpoint(tr, task_id, payload):
+    with socket.create_connection((tr.host, tr.port), timeout=5) as c:
+        c.sendall(struct.pack("<I", MAGIC))
+        for s in ("endpoint", task_id):
+            b = s.encode()
+            c.sendall(struct.pack("<I", len(b)) + b)
+        c.sendall(struct.pack("<I", 0))
+        b = payload.encode()
+        c.sendall(struct.pack("<I", len(b)) + b)
+        return struct.unpack("<I", c.recv(4))[0]
+
+
+def _fake_rank_server(rank, n_collectives):
+    rec = Recorder(capacity=32, enabled=True)
+    for i in range(n_collectives):
+        rec.record_span("engine.allreduce", 1e-3 * (rank + 1),
+                        nbytes=1 << 20, op="sum",
+                        round=rec.next_round("engine.allreduce"))
+    return live.MetricsServer(
+        sources_fn=lambda: [],
+        summary_fn=lambda: build_summary(rec.snapshot(), rank=rank,
+                                         world_size=2)).start()
+
+
+def test_tracker_live_plane_polls_and_names_straggler(monkeypatch):
+    monkeypatch.setenv("RABIT_METRICS_POLL_MS", "60")
+    srv0 = _fake_rank_server(0, 5)
+    srv1 = _fake_rank_server(1, 2)  # lags: 3 collectives behind
+    tr = Tracker(2, metrics_port=0).start()
+    try:
+        assert tr.live_stats()["metrics_addr"] is not None
+        assert _send_endpoint(tr, "0", json.dumps(
+            {"host": srv0.host, "port": srv0.port, "rank": 0})) == 1
+        assert _send_endpoint(tr, "1", json.dumps(
+            {"host": srv1.host, "port": srv1.port, "rank": 1})) == 1
+        assert _send_endpoint(tr, "x", "not json") == 0
+        deadline = time.monotonic() + 10
+        while tr.live_stats()["polls"] < 2:
+            assert time.monotonic() < deadline, tr.live_stats()
+            time.sleep(0.05)
+        host, port = tr.live_stats()["metrics_addr"]
+        ctype, text = _get(host, port, "/metrics")
+        assert "version=0.0.4" in ctype
+        assert 'rank="0"' in text and 'rank="1"' in text
+        assert "rabit_tracker_endpoints 2" in text
+        assert "rabit_straggler_lag_collectives" in text
+        _, sdoc = _get(host, port, "/straggler")
+        strag = json.loads(sdoc)
+        assert strag["lagging_rank"] == 1
+        assert strag["lag_collectives"] == 3
+        assert len(strag["ranks"]) == 2
+        _, health = _get(host, port, "/healthz")
+        assert json.loads(health)["role"] == "tracker"
+        stats = tr.live_stats()
+        assert set(stats["endpoints"]) == {"0", "1"}
+        assert stats["straggler"]["lagging_rank"] == 1
+        # polled summaries feed the SAME end-of-run merge path
+        fleet = tr.merged_metrics()
+        assert fleet is not None and fleet["num_ranks"] == 2
+    finally:
+        tr.stop()
+        srv0.stop()
+        srv1.stop()
+
+
+def test_tracker_without_metrics_port_stays_dark():
+    tr = Tracker(1).start()
+    try:
+        stats = tr.live_stats()
+        assert stats["metrics_addr"] is None and stats["polls"] == 0
+    finally:
+        tr.stop()
+
+
+# ----------------------------------------------- cross-rank round math
+
+
+def _snap(rank, arrivals, dur=0.01, name="engine.allreduce"):
+    return {"rank": rank, "t_base_unix": 1000.0,
+            "spans": [{"name": name, "t0": t, "dur": dur,
+                       "attrs": {"round": i + 1}}
+                      for i, t in enumerate(arrivals)]}
+
+
+def test_stitch_rounds_skew_and_critical_path():
+    rounds = crossrank.stitch_documents([
+        _snap(0, [0.0, 1.0, 2.0]),
+        _snap(1, [0.1, 1.0, 2.3], dur=0.05)])
+    assert len(rounds) == 3
+    r1, r2, r3 = rounds
+    assert r1["straggler_rank"] == 1 and r1["first_rank"] == 0
+    assert r1["skew_s"] == pytest.approx(0.1)
+    assert r1["critical_path_s"] == pytest.approx(0.15)
+    assert r2["skew_s"] == pytest.approx(0.0)
+    assert r3["straggler_rank"] == 1
+    assert r3["skew_s"] == pytest.approx(0.3)
+    table = crossrank.skew_table(rounds)
+    lag = [t for t in table if t["rank"] == 1][0]
+    assert lag["straggler_rounds"] == 2
+    assert lag["skew_caused_s"] == pytest.approx(0.4)
+    assert lag["worst_skew_s"] == pytest.approx(0.3)
+
+
+def test_stitch_single_rank_round_has_no_skew():
+    rounds = crossrank.stitch_documents([_snap(0, [0.0])])
+    assert rounds[0]["skew_s"] is None
+    assert rounds[0]["straggler_rank"] is None
+    assert crossrank.extract_rounds({"no": "spans"}) is None
+
+
+def test_straggler_snapshot_counter_only():
+    docs = {}
+    for tid, n in (("a", 6), ("b", 2), ("c", 6)):
+        r = Recorder(capacity=8, enabled=True)
+        for _ in range(n):
+            r.count("engine.allreduce", nbytes=1024)
+        r.count("not.collective")  # must not count toward lag
+        docs[tid] = build_summary(r.snapshot(), rank=ord(tid) - ord("a"))
+    snap = crossrank.straggler_snapshot(docs)
+    assert snap["lagging_rank"] == 1  # task "b"
+    assert snap["lag_collectives"] == 4
+    assert len(snap["ranks"]) == 3
+    assert crossrank.straggler_snapshot({})["lagging_rank"] is None
+
+
+def test_straggler_snapshot_tie_breaks_to_least_busy():
+    # Synchronizing collectives complete in lockstep, so counts tie; the
+    # real straggler arrives last and leaves at once — least busy — while
+    # the waiters burn time blocked inside the collective.
+    docs = {}
+    for tid, busy in (("a", 0.9), ("b", 0.1)):
+        r = Recorder(capacity=8, enabled=True)
+        for _ in range(4):
+            r.record_span("engine.allreduce", busy / 4, nbytes=1024)
+        docs[tid] = build_summary(r.snapshot(), rank=ord(tid) - ord("a"))
+    snap = crossrank.straggler_snapshot(docs)
+    assert snap["lagging_rank"] == 1
+    assert snap["lag_collectives"] == 0
+    assert abs(snap["busy_skew_s"] - 0.8) < 1e-6
+
+
+def test_collective_round_ids(telem):
+    assert telemetry.collective_round("x") == 1
+    assert telemetry.collective_round("x") == 2
+    assert telemetry.collective_round("y") == 1
+    telemetry.set_enabled(False)
+    assert telemetry.collective_round("x") == 0  # disabled: no advance
+    telemetry.set_enabled(True)
+    assert telemetry.collective_round("x") == 3
+
+
+# --------------------------------------------------- flight recorder
+
+
+def test_flight_round_trip_and_prune(tmp_path, telem):
+    telemetry.record_span("engine.allreduce", 1e-3, nbytes=1 << 20,
+                          round=telemetry.collective_round(
+                              "engine.allreduce"))
+    flight.note("chaos.partition", "link#0")
+    fr = flight.FlightRecorder(str(tmp_path), rank=2, keep=2,
+                               config_args=["rabit_telemetry=1"])
+    fr.install()
+    try:
+        assert flight.installed() is fr
+        paths = [fr.dump(f"reason{i}") for i in range(4)]
+        assert all(paths)
+        kept = sorted(os.listdir(tmp_path))
+        assert len(kept) == 2  # keep-pruned
+        with open(paths[-1]) as f:
+            doc = json.load(f)
+        assert matches(doc, "flight_record")
+        assert doc["reason"] == "reason3" and doc["rank"] == 2
+        assert doc["config"] == ["rabit_telemetry=1"]
+        assert doc["telemetry"]["recorded"] == 1
+        assert any(e["kind"] == "chaos.partition" for e in doc["events"])
+        assert "test_flight_round_trip" in doc["stacks"]
+        got = crossrank.extract_rounds(doc)
+        assert got is not None and got[0] == 2
+        # trigger() routes through the installed singleton
+        assert flight.trigger("via_trigger") is not None
+    finally:
+        fr.uninstall()
+    assert flight.installed() is None
+    assert flight.trigger("after_uninstall") is None
+
+
+def test_flight_from_config(tmp_path):
+    cfg = Config.from_args([f"rabit_flight_dir={tmp_path}",
+                            "rabit_flight_keep=1"])
+    fr = flight.FlightRecorder.from_config(cfg, rank=0)
+    try:
+        assert fr is not None and fr.keep == 1
+        assert flight.installed() is fr
+    finally:
+        fr.uninstall()
+    assert flight.FlightRecorder.from_config(Config.from_args([])) is None
+
+
+def test_flight_excepthook_chains(tmp_path):
+    calls = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: calls.append(a)
+    fr = flight.FlightRecorder(str(tmp_path), rank=0).install()
+    try:
+        sys.excepthook(ValueError, ValueError("boom"), None)
+        assert len(calls) == 1  # previous hook still ran
+        bundles = [f for f in os.listdir(tmp_path)
+                   if "_exception" in f]
+        assert len(bundles) == 1
+        with open(tmp_path / bundles[0]) as f:
+            assert "boom" in json.load(f)["detail"]
+    finally:
+        fr.uninstall()
+        sys.excepthook = prev
+
+
+def test_flight_sigterm_dump(tmp_path):
+    seen = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+    fr = flight.FlightRecorder(str(tmp_path), rank=0).install()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert seen == [signal.SIGTERM]  # previous handler chained
+        assert any("_sigterm" in f for f in os.listdir(tmp_path))
+    finally:
+        fr.uninstall()
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_watchdog_abort_dumps_flight_bundle(tmp_path, telem):
+    aborted = threading.Event()
+    codes = []
+
+    def seam(code):
+        codes.append(code)
+        aborted.set()
+
+    fr = flight.FlightRecorder(str(tmp_path), rank=1).install()
+    wd = Watchdog(floor_ms=40, abort=True, abort_fn=seam)
+    try:
+        with wd.guard("engine.allreduce", nbytes=1 << 20,
+                      deadline_s=0.05):
+            assert aborted.wait(10), "grace abort never fired"
+    finally:
+        wd.close()
+        fr.uninstall()
+    assert codes == [WATCHDOG_EXIT_CODE]
+    bundles = [f for f in os.listdir(tmp_path)
+               if "_watchdog_abort" in f]
+    assert len(bundles) == 1
+    with open(tmp_path / bundles[0]) as f:
+        doc = json.load(f)
+    assert doc["reason"] == "watchdog_abort"
+    assert "engine.allreduce" in doc["detail"]
+    # the escalation left its breadcrumbs too
+    names = {c["name"] for c in doc["telemetry"]["counters"]}
+    assert {"watchdog.expired", "watchdog.abort"} <= names
+    assert any(e["kind"] == "watchdog_expired" for e in doc["events"])
+
+
+# ------------------------------------------------------- lint T002
+
+
+def _load_lint():
+    spec = importlib.util.spec_from_file_location(
+        "rabit_lint_t002", os.path.join(ROOT, "tools", "lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lint_counter_contract_holds_on_repo():
+    lint = _load_lint()
+    for rel in lint.COUNTER_REQUIRED:
+        issues = lint.check_file(os.path.join(ROOT, rel))
+        assert not [i for i in issues if i[2] == "T002"], issues
+
+
+def test_lint_flags_uncounted_escalation(tmp_path, monkeypatch):
+    lint = _load_lint()
+    bare = tmp_path / "bare.py"
+    bare.write_text("def _abort(self, g):\n    self._abort_fn(86)\n")
+    rel = os.path.relpath(str(bare), lint.REPO)
+    monkeypatch.setitem(lint.COUNTER_REQUIRED, rel,
+                        {"_abort", "_vanished"})
+    codes = [c for (_, _, c, _) in lint.check_file(str(bare))]
+    assert codes.count("T002") == 2  # uncounted + missing function
+
+    good = tmp_path / "good.py"
+    good.write_text("def _abort(self, g):\n"
+                    "    telemetry.count('watchdog.abort')\n"
+                    "    self._abort_fn(86)\n")
+    rel = os.path.relpath(str(good), lint.REPO)
+    monkeypatch.setitem(lint.COUNTER_REQUIRED, rel, {"_abort"})
+    assert not [c for (_, _, c, _) in lint.check_file(str(good))
+                if c == "T002"]
+
+
+# --------------------------------------------------------- tools
+
+
+def test_capture_status_live_scrape(telem):
+    telemetry.count("engine.allreduce", nbytes=1024, op="sum")
+    srv = live.start_rank_server(0, rank=0, world=1)
+    try:
+        r = subprocess.run(
+            [sys.executable,
+             os.path.join(ROOT, "tools", "capture_status.py"),
+             "--live", f"{srv.host}:{srv.port}"],
+            capture_output=True, text=True, timeout=60, cwd=ROOT)
+        assert r.returncode == 0, r.stdout + r.stderr
+        doc = json.loads(r.stdout)
+        assert matches(doc, "live_status")
+        assert doc["ok"] and doc["exposition_ok"]
+        assert doc["health"]["rank"] == 0
+        assert doc["collectives_total"] >= 1
+    finally:
+        srv.stop()
+    # unreachable endpoint: nonzero exit, error in the doc
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "capture_status.py"),
+         "--live", f"{srv.host}:{srv.port}"],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert r.returncode == 1
+    assert "error" in json.loads(r.stdout)
+
+
+def test_trace_report_renders_flight_and_skew(tmp_path, telem):
+    for i in range(2):
+        telemetry.record_span("engine.allreduce", 1e-3, nbytes=1 << 20,
+                              op="sum",
+                              round=telemetry.collective_round(
+                                  "engine.allreduce"))
+    fr = flight.FlightRecorder(str(tmp_path), rank=0)
+    fpath = fr.dump("watchdog_abort", "engine.allreduce stalled")
+    with open(fpath) as f:
+        fdoc = json.load(f)
+    # rank 1's bundle: same rounds, arrivals 0.5s later -> straggler
+    pdoc = dict(fdoc, rank=1)
+    pdoc["telemetry"] = dict(fdoc["telemetry"], spans=[
+        dict(s, t0=s["t0"] + 0.5)
+        for s in fdoc["telemetry"]["spans"]])
+    peer = tmp_path / "peer_flight.json"
+    peer.write_text(json.dumps(pdoc))
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "trace_report.py"),
+         fpath, str(peer)],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "Flight record" in r.stdout
+    assert "`watchdog_abort`" in r.stdout
+    assert "Cross-rank rounds" in r.stdout
+    assert "Straggler: rank 1" in r.stdout
+
+
+# ----------------------------------------------- slow: real cluster
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.path.isfile(LIB),
+                    reason="native core not built")
+def test_cluster_partition_live_plane_end_to_end(tmp_path):
+    """Chaos partition with the full plane on: the tracker polls both
+    ranks' endpoints mid-run, the partition expires the watchdog
+    (abort off so the run completes), and the launch stats carry the
+    live snapshot."""
+    from rabit_tpu.tracker.launch import launch
+    chaos = {"seed": 11, "rules": [
+        {"kind": "partition", "window_s": [0.0, 3.0], "max_times": 1}]}
+    cmd = [sys.executable, os.path.join(WORKERS, "basic_worker.py"),
+           "rabit_deadline_ms=800", "rabit_watchdog_abort=0"]
+    stats = {}
+    env = {"RABIT_TELEMETRY": "1", "RABIT_METRICS_PORT": "0",
+           "RABIT_METRICS_POLL_MS": "100",
+           "RABIT_FLIGHT_DIR": str(tmp_path / "flight")}
+    old = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        rc = launch(2, cmd, max_attempts=30, timeout=180, stats=stats,
+                    chaos=chaos)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert rc == 0
+    assert stats["chaos"]["events"] >= 1, "partition never fired"
+    names = {(c["name"], c.get("provenance", ""))
+             for c in stats["fleet_metrics"]["counters"]}
+    assert ("watchdog.expired", "recovery") in names, names
+    # chaos events were counted on the launcher-side recorder contract:
+    # the injected partition shows up in the workers' watchdog counters
+    # (above); the live plane saw both ranks
+    lv = stats["live"]
+    assert lv["metrics_addr"] is not None
+    assert len(lv["endpoints"]) == 2, lv
+    assert lv["polls"] >= 1, lv
+    assert lv["straggler"] is not None
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.path.isfile(LIB),
+                    reason="native core not built")
+def test_cluster_watchdog_abort_writes_flight_bundle(tmp_path):
+    """A worker stalled in C++ rendezvous (its peer never starts) hits
+    the watchdog grace abort — exit 86 AND a flight bundle that
+    trace_report renders with the abort reason."""
+    fdir = tmp_path / "flight"
+    tr = Tracker(2, ready_timeout=60.0).start()
+    try:
+        env = dict(os.environ, PYTHONPATH=ROOT,
+                   RABIT_TELEMETRY="1",
+                   RABIT_FLIGHT_DIR=str(fdir))
+        env.update(tr.env(task_id="0"))
+        p = subprocess.Popen(
+            [sys.executable, os.path.join(WORKERS, "basic_worker.py"),
+             "rabit_deadline_ms=1500"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        _, err = p.communicate(timeout=60)
+        assert p.returncode == WATCHDOG_EXIT_CODE, \
+            (p.returncode, err.decode(errors="replace")[-2000:])
+    finally:
+        tr.stop()
+    bundles = [f for f in os.listdir(fdir) if "_watchdog_abort" in f]
+    assert len(bundles) == 1, os.listdir(fdir)
+    with open(fdir / bundles[0]) as f:
+        doc = json.load(f)
+    assert matches(doc, "flight_record")
+    assert doc["reason"] == "watchdog_abort"
+    assert "engine.init" in doc["detail"]
+    assert doc["stacks"], "no thread stacks captured"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "trace_report.py"),
+         str(fdir / bundles[0])],
+        capture_output=True, text=True, timeout=60, cwd=ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "`watchdog_abort`" in r.stdout

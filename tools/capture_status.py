@@ -19,6 +19,14 @@ shared schema header (``rabit_tpu.capture_status/v1`` — the same
 header family as BENCH_*/COLLECTIVE_SWEEP_*/telemetry artifacts), so
 the watcher parses a versioned document instead of grepping ad-hoc
 ``MISSING`` lines. Exit codes are unchanged.
+
+`--live HOST:PORT` scrapes a running rank's (or the tracker's) live
+metrics endpoint (``rabit_metrics_port``, telemetry/live.py) instead
+of the on-disk evidence set: it GETs ``/healthz`` and ``/metrics``,
+validates the Prometheus exposition, and emits one
+``rabit_tpu.live_status/v1`` JSON line (identity, sample count,
+collective counter total). Exit 0 when the endpoint is healthy,
+1 when unreachable or unhealthy.
 """
 
 import glob
@@ -107,7 +115,51 @@ def missing():
     return gaps
 
 
+def live_status(target):
+    """Scrape HOST:PORT's /healthz + /metrics; return (doc, ok)."""
+    import urllib.error
+    import urllib.request
+    host, _, port = target.rpartition(":")
+    doc = make_header("live_status")
+    doc["target"] = target
+    doc["ok"] = False
+    try:
+        base = f"http://{host}:{int(port)}"
+    except ValueError:
+        doc["error"] = f"bad target {target!r} (want HOST:PORT)"
+        return doc, False
+    try:
+        with urllib.request.urlopen(base + "/healthz", timeout=5.0) as r:
+            health = json.load(r)
+        with urllib.request.urlopen(base + "/metrics", timeout=5.0) as r:
+            ctype = r.headers.get("Content-Type", "")
+            text = r.read().decode()
+    except (OSError, ValueError, urllib.error.URLError) as e:
+        doc["error"] = f"{type(e).__name__}: {e}"
+        return doc, False
+    doc["health"] = health
+    doc["exposition_ok"] = ("version=0.0.4" in ctype
+                            and "# TYPE" in text)
+    samples = [ln for ln in text.splitlines()
+               if ln and not ln.startswith("#")]
+    doc["samples"] = len(samples)
+    collectives = 0
+    for ln in samples:
+        if ln.startswith("rabit_collective_total"):
+            try:
+                collectives += int(float(ln.rsplit(None, 1)[1]))
+            except (ValueError, IndexError):
+                pass
+    doc["collectives_total"] = collectives
+    doc["ok"] = bool(health.get("ok")) and doc["exposition_ok"]
+    return doc, doc["ok"]
+
+
 def main():
+    if len(sys.argv) == 3 and sys.argv[1] == "--live":
+        doc, ok = live_status(sys.argv[2])
+        print(json.dumps(doc, sort_keys=True))
+        sys.exit(0 if ok else 1)
     gaps = missing()
     if len(sys.argv) == 3 and sys.argv[1] == "--have":
         item = sys.argv[2]

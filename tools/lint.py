@@ -19,6 +19,12 @@ this implements the highest-signal subset with only the stdlib:
   ``telemetry.span(...)`` or ``telemetry.trace_annotation(...)`` call —
   an uninstrumented hot path silently disappears from traces, fleet
   tables, and the dispatch accounting.
+- **escalation counter presence** (T002, repo-specific): failure
+  escalation paths (the COUNTER_REQUIRED map — watchdog expiry/abort,
+  chaos fault injection) must record a telemetry counter
+  (``telemetry.count(...)`` / ``record_span`` / ``record_dispatch``) —
+  an uncounted escalation is invisible to fleet tables, the live
+  ``/metrics`` endpoints, and post-mortem flight bundles.
 - **unretried control-plane sockets** (R001, repo-specific): raw
   ``socket.socket(...)`` / ``socket.create_connection(...)`` calls
   inside ``rabit_tpu/`` must go through ``utils/retry.py``
@@ -61,6 +67,17 @@ SPAN_REQUIRED = {
 }
 
 _SPAN_CALL_NAMES = {"span", "trace_annotation"}
+
+# Failure escalation paths that must leave a telemetry counter behind:
+# rel path -> required function names. Keep in sync with
+# doc/observability.md's instrumentation table.
+COUNTER_REQUIRED = {
+    os.path.join("rabit_tpu", "utils", "watchdog.py"): {
+        "_escalate", "_abort"},
+    os.path.join("rabit_tpu", "chaos", "proxy.py"): {"_event"},
+}
+
+_COUNTER_CALL_NAMES = {"count", "record_span", "record_dispatch"}
 
 # R001: files allowed to construct sockets directly. Listeners/servers
 # (which accept rather than connect), the retry module itself, and the
@@ -105,16 +122,24 @@ def _r001_issues(rel, tree, src):
     return issues
 
 
-def _has_span_call(fn_node) -> bool:
+def _calls_any(fn_node, call_names) -> bool:
     for node in ast.walk(fn_node):
         if not isinstance(node, ast.Call):
             continue
         f = node.func
         name = f.attr if isinstance(f, ast.Attribute) else (
             f.id if isinstance(f, ast.Name) else None)
-        if name in _SPAN_CALL_NAMES:
+        if name in call_names:
             return True
     return False
+
+
+def _has_span_call(fn_node) -> bool:
+    return _calls_any(fn_node, _SPAN_CALL_NAMES)
+
+
+def _has_counter_call(fn_node) -> bool:
+    return _calls_any(fn_node, _COUNTER_CALL_NAMES)
 
 
 def iter_py_files(paths):
@@ -223,6 +248,22 @@ def check_file(path: str):
             issues.append((rel, 1, "T001",
                            f"expected collective entry point '{name}' "
                            "not found (update SPAN_REQUIRED)"))
+    counters = COUNTER_REQUIRED.get(rel)
+    if counters:
+        seen = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in counters and node.name not in seen:
+                seen.add(node.name)
+                if not _has_counter_call(node):
+                    issues.append((
+                        rel, node.lineno, "T002",
+                        f"escalation path '{node.name}' records no "
+                        "telemetry counter"))
+        for name in sorted(counters - seen):
+            issues.append((rel, 1, "T002",
+                           f"expected escalation path '{name}' not "
+                           "found (update COUNTER_REQUIRED)"))
     return issues
 
 

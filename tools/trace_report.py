@@ -10,8 +10,16 @@ schema-versioned artifact the repo emits —
 - ``rabit_tpu.telemetry_trace/v1``   (Chrome trace-event file — also
   loadable directly in https://ui.perfetto.dev / chrome://tracing)
 - ``rabit_tpu.collective_sweep/v1``  (dispatch-table artifacts)
+- ``rabit_tpu.flight_record/v1``     (crash flight-recorder bundles —
+  last spans, noted wire/chaos events, per-thread stacks)
 
 — and it prints a GitHub-markdown table ready to paste into PERF.md.
+
+Given MULTIPLE artifacts whose spans carry collective round ids
+(traces, flight bundles, raw snapshots — one per rank), the report
+appends a cross-rank section: per-round arrival skew and critical
+path, plus a per-rank attribution table naming who straggled
+(telemetry/crossrank.py).
 
 ``--smoke`` is the CI contract check wired into scripts/run_tests.sh:
 record deterministic spans, export both artifacts, reload them through
@@ -31,6 +39,7 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+from rabit_tpu.telemetry import crossrank  # noqa: E402
 from rabit_tpu.telemetry.schema import matches  # noqa: E402
 
 
@@ -123,11 +132,84 @@ def render_sweep(doc):
         ("class", "max n", "method", "wire"), rows)
 
 
+def render_flight(doc, last_n=16):
+    """flight_record bundle: why the process died, what it was doing
+    (last spans, round ids included), what was injected/escalated just
+    before (noted events), and where every thread was blocked."""
+    detail = f" — {doc['detail']}" if doc.get("detail") else ""
+    parts = [f"Flight record — rank {doc.get('rank', '?')}, reason "
+             f"`{doc.get('reason', '?')}`{detail}, pid "
+             f"{doc.get('pid', '?')} ({doc.get('timestamp_utc', '')})"]
+    telem = doc.get("telemetry") or {}
+    spans = telem.get("spans", [])[-last_n:]
+    if spans:
+        rows = [(s["name"], (s.get("attrs") or {}).get("round", "-"),
+                 f"{s.get('t0', 0.0):.3f}", _fmt_s(s.get("dur", 0.0)),
+                 _fmt_bytes(s.get("bytes", 0)), s.get("op") or "-",
+                 s.get("method") or "-") for s in spans]
+        parts.append(f"Last {len(spans)} span(s) of "
+                     f"{telem.get('recorded', 0)} recorded\n\n" +
+                     _md_table(("span", "round", "t0 (s)", "dur", "bytes",
+                                "op", "method"), rows))
+    rec = [c for c in telem.get("counters", [])
+           if (c.get("provenance") or "") in ("recovery", "chaos")]
+    if rec:
+        rows = [(c["name"], c.get("provenance"), c["op"] or "-",
+                 c["count"]) for c in rec]
+        parts.append("Recovery/chaos counters\n\n" +
+                     _md_table(("event", "provenance", "op", "count"),
+                               rows))
+    events = doc.get("events", [])[-last_n:]
+    if events:
+        rows = [(f"{e.get('t_unix', 0.0):.3f}", e.get("kind", "?"),
+                 e.get("detail", "") or "-") for e in events]
+        parts.append(f"Last {len(events)} noted event(s)\n\n" +
+                     _md_table(("t_unix", "kind", "detail"), rows))
+    stacks = doc.get("stacks") or ""
+    if stacks:
+        nthreads = stacks.count("Thread ") + stacks.count(
+            "Current thread ")
+        parts.append(f"Per-thread stacks ({max(1, nthreads)} thread(s))"
+                     "\n\n```\n" + stacks.strip() + "\n```")
+    return "\n\n".join(parts)
+
+
+def render_skew(docs):
+    """Cross-rank section from >=2 round-carrying artifacts: per-round
+    arrival skew/critical path plus per-rank straggler attribution.
+    Returns None when fewer than two ranks contributed rounds."""
+    rounds = crossrank.stitch_documents(docs)
+    comparable = [r for r in rounds if r["skew_s"] is not None]
+    if not comparable:
+        return None
+    rows = [(r["name"], r["round"], len(r["arrivals"]),
+             r["straggler_rank"], _fmt_s(r["skew_s"]),
+             _fmt_s(r["critical_path_s"])) for r in comparable]
+    out = (f"Cross-rank rounds ({len(comparable)} comparable of "
+           f"{len(rounds)} stitched)\n\n" +
+           _md_table(("collective", "round", "ranks", "straggler",
+                      "arrival skew", "critical path"), rows))
+    attr = crossrank.skew_table(comparable)
+    arow = [(a["rank"], a["rounds"], a["straggler_rounds"],
+             _fmt_s(a["skew_caused_s"]), _fmt_s(a["worst_skew_s"]))
+            for a in attr]
+    worst = max(attr, key=lambda a: a["skew_caused_s"])
+    out += ("\n\nPer-rank straggler attribution\n\n" +
+            _md_table(("rank", "rounds seen", "times straggler",
+                       "skew caused", "worst skew"), arow))
+    out += (f"\n\nStraggler: rank {worst['rank']} caused "
+            f"{_fmt_s(worst['skew_caused_s'])} of arrival skew across "
+            f"{worst['straggler_rounds']} round(s)")
+    return out
+
+
 def render(doc):
     if matches(doc, "telemetry_summary") or matches(doc, "telemetry_fleet"):
         return render_counters(doc)
     if matches(doc, "telemetry_trace"):
         return render_trace(doc)
+    if matches(doc, "flight_record"):
+        return render_flight(doc)
     if doc.get("schema") == "rabit_tpu.collective_sweep/v1":
         return render_sweep(doc)
     raise SystemExit(f"unrecognized artifact schema {doc.get('schema')!r}")
@@ -174,13 +256,37 @@ def smoke(out_dir):
     print(render(summary))
     print()
     print(render(trace))
+    # flight-record rendering + cross-rank stitch round-trip
+    from rabit_tpu.telemetry.flight import FlightRecorder
+    telemetry.reset(capacity=64, enabled=True)
+    for i in range(2):
+        telemetry.record_span(
+            "engine.allreduce", 1e-3, nbytes=1 << 20, op="sum",
+            round=telemetry.collective_round("engine.allreduce"))
+    fr = FlightRecorder(out_dir, rank=0)
+    fpath = fr.dump("smoke")
+    assert fpath, "flight dump failed"
+    with open(fpath) as f:
+        fdoc = json.load(f)
+    body = render(fdoc)
+    assert "Flight record" in body and "`smoke`" in body, body[:200]
+    peer = {"rank": 1,
+            "t_base_unix": fdoc["t_base_unix"],
+            "spans": [{"name": "engine.allreduce", "t0": 0.25,
+                       "dur": 1e-3, "attrs": {"round": r}}
+                      for r in (1, 2)]}
+    skew = render_skew([fdoc, peer])
+    assert skew is not None and "Straggler: rank" in skew, skew
+    telemetry.reset()
     print("telemetry smoke ok")
 
 
 def main():
     ap = argparse.ArgumentParser(
         description="render telemetry/sweep artifacts as PERF.md tables")
-    ap.add_argument("artifact", nargs="?", help="path to a *.json artifact")
+    ap.add_argument("artifact", nargs="*",
+                    help="path(s) to *.json artifacts; several "
+                    "round-carrying ones add a cross-rank skew section")
     ap.add_argument("--smoke", action="store_true",
                     help="record->export->render round-trip (CI contract)")
     ap.add_argument("--dir", default="/tmp/rabit_telemetry_smoke",
@@ -191,9 +297,17 @@ def main():
         return 0
     if not args.artifact:
         ap.error("need an artifact path (or --smoke)")
-    with open(args.artifact) as f:
-        doc = json.load(f)
-    print(render(doc))
+    docs = []
+    for path in args.artifact:
+        with open(path) as f:
+            doc = json.load(f)
+        docs.append(doc)
+        print(render(doc))
+        print()
+    if len(docs) >= 2:
+        skew = render_skew(docs)
+        if skew is not None:
+            print(skew)
     return 0
 
 
