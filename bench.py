@@ -31,6 +31,10 @@ import threading
 import time
 
 _REPO = __file__.rsplit("/", 1)[0]
+# perf evidence lives under benchmarks/artifacts/ (the regression
+# sentinel ingests from there); the repo root is still scanned when
+# reading so pre-move checkouts keep their cached-line fallback
+_ARTIFACTS = os.path.join(_REPO, "benchmarks", "artifacts")
 sys.path.insert(0, _REPO)
 
 
@@ -54,7 +58,10 @@ _BEST_LINE: dict | None = None  # updated as soon as a headline is measured
 
 
 def _newest_local_artifact() -> dict | None:
-    paths = sorted(glob.glob(os.path.join(_REPO, "BENCH_LOCAL_*.json")))
+    paths = sorted(
+        glob.glob(os.path.join(_ARTIFACTS, "BENCH_LOCAL_*.json"))
+        + glob.glob(os.path.join(_REPO, "BENCH_LOCAL_*.json")),
+        key=os.path.basename)
     for path in reversed(paths):
         try:
             with open(path) as f:
@@ -250,14 +257,28 @@ def _write_local_artifact(payload: dict) -> None:
     run; committed with the round's work."""
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y%m%dT%H%M%SZ")
-    path = os.path.join(_REPO, f"BENCH_LOCAL_{ts}.json")
+    doc = dict(payload, timestamp_utc=ts)
+    path = os.path.join(_ARTIFACTS, f"BENCH_LOCAL_{ts}.json")
     try:
+        os.makedirs(_ARTIFACTS, exist_ok=True)
         with open(path, "w") as f:
-            json.dump(dict(payload, timestamp_utc=ts), f, indent=1)
+            json.dump(doc, f, indent=1)
             f.write("\n")
         print(f"# wrote {path}", file=sys.stderr, flush=True)
     except OSError as e:  # pragma: no cover - artifact is best-effort
         print(f"# artifact write failed: {e}", file=sys.stderr, flush=True)
+        return
+    # every real run also lands in the regression-sentinel history, so
+    # tools/bench_sentinel.py trends it against prior same-config runs
+    try:
+        from rabit_tpu.telemetry import history
+        recs = history.records_from_artifact(
+            doc, source=os.path.basename(path))
+        n = history.append(history.history_path(_REPO), recs)
+        print(f"# appended {n} history records", file=sys.stderr,
+              flush=True)
+    except Exception as e:  # pragma: no cover - history is best-effort
+        print(f"# history append failed: {e}", file=sys.stderr, flush=True)
 
 
 def main() -> None:

@@ -25,6 +25,7 @@ import numpy as np
 from .base import Engine
 from . import ckpt_store
 from .. import telemetry
+from ..telemetry import profile as _profile
 from ..ops.reducers import DTYPE_ENUM, OP_NAMES
 from ..utils import log
 from ..utils.watchdog import Watchdog
@@ -227,6 +228,7 @@ class NativeEngine(Engine):
         log.set_debug(cfg.get_bool("rabit_debug"))
         log.set_identity(self.rank, self.world_size)
         telemetry.configure(cfg)
+        _profile.configure(cfg)
         self._start_live_plane(cfg)
         ckpt_dir = cfg.get("rabit_ckpt_dir")
         if ckpt_dir:
@@ -324,6 +326,7 @@ class NativeEngine(Engine):
             # ordering between ranks is needed (see dataplane.py)
             self._dataplane.shutdown()
             self._dataplane = None
+        _profile.stop_poller()
         # telemetry must flush BEFORE finalize: RbtFinalize sends the
         # tracker its shutdown command, and the tracker exits (printing
         # the fleet table) once every rank has. Both are best-effort —

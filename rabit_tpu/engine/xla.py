@@ -33,6 +33,7 @@ import numpy as np
 from .base import Engine
 from . import ckpt_store
 from .. import telemetry
+from ..telemetry import profile as _profile
 from ..utils.config import Config
 from ..utils import log
 from ..utils.log import log_debug
@@ -114,6 +115,7 @@ class XlaEngine(Engine):
         log.set_debug(self._debug)
         log.set_identity(self._rank, self._world)
         telemetry.configure(cfg)
+        _profile.configure(cfg)
         self._watchdog = Watchdog.from_config(cfg)
         self._start_live_plane(cfg)
         ckpt_dir = cfg.get("rabit_ckpt_dir")
@@ -170,6 +172,7 @@ class XlaEngine(Engine):
         if self._flight is not None:
             self._flight.uninstall()
             self._flight = None
+        _profile.stop_poller()
         telemetry.export_at_shutdown(self._rank, self._world)
 
     # -- collectives ------------------------------------------------------

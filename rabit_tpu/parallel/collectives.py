@@ -47,6 +47,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
+from ..telemetry import profile as _profile
 from ..ops.reducers import SUM, MAX, MIN, BITOR, OP_NAMES, jax_reduce_fn
 from .dispatch import (RING_MINCOUNT_DEFAULT,  # noqa: F401  (re-export)
                        WIRE_MINCOUNT_DEFAULT, resolve as _dispatch_resolve)
@@ -613,11 +614,17 @@ def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
     n = int(np.prod(xs.shape[1:]))
     method, wire = _dispatch_resolve(n, xs.dtype, op, mesh.shape[axis],
                                      method=method, wire=wire)
+    cost = _profile.record_cost("allreduce", method, wire, n,
+                                xs.dtype.itemsize, mesh.shape[axis])
+    extra = ({"cost_flops": cost["flops"],
+              "cost_wire_bytes": cost["wire_bytes"],
+              "cost_hops": cost["hops"]} if cost else {})
     sp = telemetry.span("allreduce", nbytes=n * xs.dtype.itemsize,
                         op=OP_NAMES.get(op, str(op)), method=method,
-                        wire=wire)
+                        wire=wire, **extra)
     with sp:
-        out = _allreduce_global(xs, mesh, axis, op, method, wire)
+        with _profile.jit_probe("allreduce", _allreduce_global):
+            out = _allreduce_global(xs, mesh, axis, op, method, wire)
         if sp.live:
             # only when measuring: a span closed on dispatch would time
             # the async enqueue, not the collective
@@ -723,14 +730,17 @@ def device_allreduce_tree(tree, mesh: Mesh, op: int = SUM,
                                    method=method, wire=wire)
         spec.append((dt.name, mth, w or ""))  # "" keeps the key hashable
         nbytes += n * dt.itemsize
+        _profile.record_cost("allreduce_tree", mth, w, n, dt.itemsize,
+                             mesh.shape[axis])
     spec = tuple(sorted(spec))
     sp = telemetry.span(
         "allreduce_tree", nbytes=nbytes, op=OP_NAMES.get(op, str(op)),
         method=",".join(sorted({m for _, m, _ in spec})),
         buckets=len(spec), leaves=len(leaves))
     with sp:
-        out = _allreduce_tree_global(tuple(leaves), treedef, mesh, axis,
-                                     op, spec)
+        with _profile.jit_probe("allreduce_tree", _allreduce_tree_global):
+            out = _allreduce_tree_global(tuple(leaves), treedef, mesh,
+                                         axis, op, spec)
         if sp.live:
             jax.block_until_ready(out)
     return out
@@ -752,10 +762,13 @@ def device_broadcast(xs: jax.Array, mesh: Mesh, root: int = 0,
     if axis is None:
         axis = mesh.axis_names[0]
     n = int(np.prod(xs.shape[1:]))
+    _profile.record_cost("broadcast", "psum_mask", None, n,
+                         xs.dtype.itemsize, mesh.shape[axis])
     sp = telemetry.span("broadcast", nbytes=n * xs.dtype.itemsize,
                         method="psum_mask", root=root)
     with sp:
-        out = _broadcast_global(xs, mesh, axis, root)
+        with _profile.jit_probe("broadcast", _broadcast_global):
+            out = _broadcast_global(xs, mesh, axis, root)
         if sp.live:
             out.block_until_ready()
     return out

@@ -59,10 +59,20 @@ def _repo_root() -> str:
         os.path.dirname(os.path.abspath(__file__))))
 
 
+def artifacts_dir() -> str:
+    """Where committed perf artifacts live (``benchmarks/artifacts/``);
+    every capture tool writes here and history ingestion reads here."""
+    return os.path.join(_repo_root(), "benchmarks", "artifacts")
+
+
 def _newest_sweep() -> Optional[str]:
-    """Newest committed sweep artifact (timestamped names sort)."""
-    found = sorted(glob.glob(
-        os.path.join(_repo_root(), "COLLECTIVE_SWEEP_*.json")))
+    """Newest committed sweep artifact (timestamped names sort).
+    Scans ``benchmarks/artifacts/`` plus the repo root (pre-move
+    layouts and user-dropped tables keep working)."""
+    found = sorted(
+        glob.glob(os.path.join(artifacts_dir(), "COLLECTIVE_SWEEP_*.json"))
+        + glob.glob(os.path.join(_repo_root(), "COLLECTIVE_SWEEP_*.json")),
+        key=os.path.basename)
     return found[-1] if found else None
 
 
@@ -93,7 +103,8 @@ def load_table(path: Optional[str] = None) -> Optional[dict]:
 
     Resolution order: explicit ``path`` arg, ``RABIT_DISPATCH_TABLE``
     env (``none``/``off``/``0`` disables), newest
-    ``COLLECTIVE_SWEEP_*.json`` at the repo root. A missing file, a
+    ``COLLECTIVE_SWEEP_*.json`` under ``benchmarks/artifacts/`` (repo
+    root also scanned for compatibility). A missing file, a
     schema other than exactly ``rabit_tpu.collective_sweep/v1`` (future
     majors must not be misread), or malformed rows all yield None —
     dispatch must degrade to the documented defaults, never crash.
@@ -109,9 +120,12 @@ def load_table(path: Optional[str] = None) -> Optional[dict]:
         mtime = os.path.getmtime(path)
     except OSError:
         return None
+    from ..telemetry import profile
     hit = _cache.get(path)
     if hit is not None and hit[0] == mtime:
+        profile.cache_event("dispatch_table", hit=True)
         return hit[1]
+    profile.cache_event("dispatch_table", hit=False)
     table = None
     try:
         with open(path) as f:

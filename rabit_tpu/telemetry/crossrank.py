@@ -149,6 +149,12 @@ def stitch_documents(docs: Iterable[dict]) -> List[dict]:
 
 _COLLECTIVE_PREFIXES = ("engine.", "dataplane.")
 
+# a fleet whose laggard is behind by zero rounds AND whose busy-time
+# spread is under this is healthy — no rank gets named (the periodic
+# tracker print has always used this threshold; the endpoint now does
+# too instead of naming an arbitrary tie-break winner)
+BUSY_SKEW_SIGNAL_S = 1.0
+
 
 def straggler_snapshot(summaries: Dict[str, dict]) -> dict:
     """Who is behind, from live-polled ``telemetry_summary`` docs
@@ -158,8 +164,13 @@ def straggler_snapshot(summaries: Dict[str, dict]) -> dict:
     time: synchronizing collectives complete in lockstep, and the rank
     everyone waits for is the one that arrives last and leaves at once,
     while the waiters burn their time blocked inside the collective.
-    Returns per-rank rows plus the named laggard; the tracker serves
-    this as ``/straggler`` and as gauges on its ``/metrics``."""
+
+    Returns per-rank rows plus an explicit verdict: ``signal`` is True
+    only when someone is measurably behind (a round lag, or busy skew
+    over ``BUSY_SKEW_SIGNAL_S``); ``lagging_rank`` is named only then.
+    ``candidate_rank`` always carries the tie-break winner so callers
+    can see who WOULD be named. The tracker serves this as
+    ``/straggler`` and as gauges on its ``/metrics``."""
     rows = []
     for tid in sorted(summaries, key=str):
         doc = summaries[tid]
@@ -175,13 +186,17 @@ def straggler_snapshot(summaries: Dict[str, dict]) -> dict:
         rows.append({"task_id": str(tid), "rank": doc.get("rank", -1),
                      "collectives": int(count), "busy_s": busy,
                      "max_s": maxs})
-    snap = {"ranks": rows, "lagging_rank": None, "lag_collectives": 0,
-            "busy_skew_s": 0.0}
+    snap = {"ranks": rows, "lagging_rank": None, "candidate_rank": None,
+            "lag_collectives": 0, "busy_skew_s": 0.0, "signal": False}
     if len(rows) >= 2:
         lead = max(r["collectives"] for r in rows)
         lag = min(rows, key=lambda r: (r["collectives"], r["busy_s"]))
-        snap["lagging_rank"] = lag["rank"]
+        snap["candidate_rank"] = lag["rank"]
         snap["lag_collectives"] = lead - lag["collectives"]
         busys = [r["busy_s"] for r in rows]
         snap["busy_skew_s"] = max(busys) - min(busys)
+        snap["signal"] = (snap["lag_collectives"] > 0
+                          or snap["busy_skew_s"] > BUSY_SKEW_SIGNAL_S)
+        if snap["signal"]:
+            snap["lagging_rank"] = lag["rank"]
     return snap

@@ -31,6 +31,13 @@ def build_summary(snapshot: dict, rank: int = -1,
     doc["capacity"] = snapshot["capacity"]
     doc["t_base_unix"] = snapshot.get("t_base_unix", 0.0)
     doc["counters"] = snapshot["counters"]
+    # the profiling plane rides the summary: per-rank /summary, the
+    # tracker's rank-labelled fleet /metrics, and the shutdown artifact
+    # all gain the rabit_compile_*/jit_cache/cost/device_mem families
+    # with no extra wiring (prom.py renders doc["profile"])
+    from . import profile
+    if profile.enabled():
+        doc["profile"] = profile.snapshot()
     return doc
 
 

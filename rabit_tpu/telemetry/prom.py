@@ -34,6 +34,43 @@ _LABEL_FIELDS = ("name", "op", "method", "wire", "bucket", "provenance")
 # extra gauge spec: (metric_name, help_text, type, [(labels, value)])
 GaugeSpec = Tuple[str, str, str, List[Tuple[Dict[str, str], float]]]
 
+# The single registry of every /metrics family name this repo exports,
+# anywhere (recorder families rendered below, profile-plane families,
+# and the engine/tracker extra gauges). Lint rule T003 (tools/lint.py)
+# AST-scans the exporters and fails on any family name absent from this
+# table — a new metric must be registered here to ship.
+METRIC_FAMILIES = (
+    # recorder counters (rendered by render_prometheus)
+    "rabit_collective_total",
+    "rabit_collective_bytes_total",
+    "rabit_collective_seconds_total",
+    "rabit_collective_max_seconds",
+    "rabit_collective_duration_seconds",
+    "rabit_telemetry_recorded_total",
+    "rabit_telemetry_dropped_total",
+    "rabit_telemetry_buffer_capacity",
+    "rabit_telemetry_enabled",
+    # profiling plane (telemetry/profile.py section, rendered below)
+    "rabit_compile_total",
+    "rabit_compile_seconds_total",
+    "rabit_compile_max_seconds",
+    "rabit_jit_cache_hits_total",
+    "rabit_jit_cache_misses_total",
+    "rabit_collective_cost_flops_total",
+    "rabit_collective_cost_wire_bytes_total",
+    "rabit_device_mem_live_bytes",
+    "rabit_device_mem_peak_bytes",
+    "rabit_device_mem_arrays",
+    # engine extra gauges (engine/xla.py, engine/native.py)
+    "rabit_watchdog_expired_total",
+    "rabit_world_epoch",
+    # tracker fleet gauges (tracker/tracker.py)
+    "rabit_tracker_endpoints",
+    "rabit_tracker_polls_total",
+    "rabit_straggler_lag_collectives",
+    "rabit_straggler_busy_skew_seconds",
+)
+
 
 def escape_label_value(v: str) -> str:
     """Label-value escaping per the exposition format: backslash,
@@ -113,6 +150,39 @@ def render_prometheus(sources: Iterable[Tuple[Dict[str, str], dict]],
                             "Ring-buffer capacity in spans.", "gauge"),
         "enabled": _Family("rabit_telemetry_enabled",
                            "1 when the recorder is enabled.", "gauge"),
+        # profiling plane (summary docs carry a "profile" section when
+        # rabit_profile=1; see telemetry/profile.py)
+        "compile_n": _Family("rabit_compile_total",
+                             "Jit compilations observed per probed "
+                             "function.", "counter"),
+        "compile_s": _Family("rabit_compile_seconds_total",
+                             "Wall seconds spent in trace+compile "
+                             "(first-call cost) per probed function.",
+                             "counter"),
+        "compile_max": _Family("rabit_compile_max_seconds",
+                               "Slowest single compile per probed "
+                               "function.", "gauge"),
+        "jit_hits": _Family("rabit_jit_cache_hits_total",
+                            "Jit/trace cache hits per probed function.",
+                            "counter"),
+        "jit_misses": _Family("rabit_jit_cache_misses_total",
+                              "Jit/trace cache misses per probed "
+                              "function.", "counter"),
+        "cost_flops": _Family("rabit_collective_cost_flops_total",
+                              "Analytic reduction FLOPs per collective "
+                              "(name,method,wire).", "counter"),
+        "cost_bytes": _Family("rabit_collective_cost_wire_bytes_total",
+                              "Analytic wire bytes per collective "
+                              "(name,method,wire).", "counter"),
+        "mem_live": _Family("rabit_device_mem_live_bytes",
+                            "Live device bytes at the last sample.",
+                            "gauge"),
+        "mem_peak": _Family("rabit_device_mem_peak_bytes",
+                            "High-water device bytes since reset.",
+                            "gauge"),
+        "mem_arrays": _Family("rabit_device_mem_arrays",
+                              "Live jax arrays at the last sample.",
+                              "gauge"),
     }
     for base, doc in sources:
         base = dict(base or {})
@@ -142,9 +212,36 @@ def render_prometheus(sources: Iterable[Tuple[Dict[str, str], dict]],
                 fams["hist"].add(labels, float(row.get("total_s", 0.0)),
                                  suffix="_sum")
                 fams["hist"].add(labels, cum, suffix="_count")
+        prof = doc.get("profile")
+        if prof:
+            for row in prof.get("compile", []):
+                labels = dict(base)
+                labels["fn"] = str(row.get("fn", ""))
+                fams["compile_n"].add(labels, int(row.get("count", 0)))
+                fams["compile_s"].add(labels, float(row.get("total_s", 0.0)))
+                fams["compile_max"].add(labels, float(row.get("max_s", 0.0)))
+            for row in prof.get("jit_cache", []):
+                labels = dict(base)
+                labels["fn"] = str(row.get("fn", ""))
+                fams["jit_hits"].add(labels, int(row.get("hits", 0)))
+                fams["jit_misses"].add(labels, int(row.get("misses", 0)))
+            for row in prof.get("cost", []):
+                labels = dict(base)
+                for f in ("name", "method", "wire"):
+                    labels[f] = str(row.get(f, "") or "")
+                fams["cost_flops"].add(labels, int(row.get("flops", 0)))
+                fams["cost_bytes"].add(labels,
+                                       int(row.get("wire_bytes", 0)))
+            mem = prof.get("device_mem") or {}
+            if mem.get("samples"):
+                fams["mem_live"].add(base, int(mem.get("live_bytes", 0)))
+                fams["mem_peak"].add(base, int(mem.get("peak_bytes", 0)))
+                fams["mem_arrays"].add(base, int(mem.get("arrays", 0)))
     lines: List[str] = []
     order = ("count", "bytes", "secs", "max", "hist", "recorded",
-             "dropped", "capacity", "enabled")
+             "dropped", "capacity", "enabled", "compile_n", "compile_s",
+             "compile_max", "jit_hits", "jit_misses", "cost_flops",
+             "cost_bytes", "mem_live", "mem_peak", "mem_arrays")
     for key in order:
         lines.extend(fams[key].lines())
     for name, help_text, mtype, samples in gauges:

@@ -365,7 +365,8 @@ class Tracker:
     def _straggler_doc(self) -> dict:
         with self._lock:
             strag = self._last_straggler
-        return strag if strag is not None else {"ranks": []}
+        return strag if strag is not None else {"ranks": [],
+                                                "signal": False}
 
     def _poll_loop(self) -> None:
         from ..telemetry import crossrank, live
@@ -391,9 +392,10 @@ class Tracker:
             # only while someone is actually behind — in the round
             # sequence, or >1s of accumulated in-collective wait
             since_snapshot += 1
-            behind = strag.get("lagging_rank") is not None and (
-                strag.get("lag_collectives", 0) > 0
-                or strag.get("busy_skew_s", 0.0) > 1.0)
+            # the snapshot's signal verdict carries the same threshold
+            # this print used to re-derive (crossrank.BUSY_SKEW_SIGNAL_S)
+            behind = bool(strag.get("signal")) \
+                and strag.get("lagging_rank") is not None
             if since_snapshot >= 5 and behind:
                 since_snapshot = 0
                 print(f"[tracker] straggler: rank "

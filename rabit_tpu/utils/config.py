@@ -35,6 +35,8 @@ ENV_VARS = [
     "RABIT_TELEMETRY",
     "RABIT_TELEMETRY_BUFFER",
     "RABIT_TELEMETRY_EXPORT",
+    "RABIT_PROFILE",
+    "RABIT_PROFILE_MEMORY_POLL_MS",
     "RABIT_TRACKER_READY_TIMEOUT",
     "RABIT_DATAPLANE_INIT_TIMEOUT",
     "RABIT_DEADLINE_MS",

@@ -14,13 +14,15 @@ cd "$(dirname "$0")/.."
 echo "== tier 0: lint =="
 if command -v ruff >/dev/null 2>&1; then
   ruff check rabit_tpu tools tests examples bench.py setup.py
-  # ruff can't know the repo-specific span-presence (T001) and
-  # escalation-counter (T002) contracts; run the stdlib linter for
-  # those checks either way
+  # ruff can't know the repo-specific span-presence (T001),
+  # escalation-counter (T002) and metric-family-registration (T003)
+  # contracts; run the stdlib linter for those checks either way
   python tools/lint.py rabit_tpu/parallel/collectives.py \
       rabit_tpu/engine/xla.py rabit_tpu/engine/native.py \
       rabit_tpu/engine/dataplane.py rabit_tpu/utils/watchdog.py \
-      rabit_tpu/chaos/proxy.py
+      rabit_tpu/chaos/proxy.py rabit_tpu/telemetry/prom.py \
+      rabit_tpu/telemetry/live.py rabit_tpu/telemetry/profile.py \
+      rabit_tpu/tracker/tracker.py
 else
   # containers without ruff fall back to the stdlib-only subset
   python tools/lint.py
@@ -35,6 +37,9 @@ python -m rabit_tpu.chaos --smoke
 
 echo "== tier 0d: live-plane smoke (endpoint -> scrape -> flight) =="
 python -m rabit_tpu.telemetry --smoke
+
+echo "== tier 0e: regression-sentinel smoke (ingest -> MAD gate) =="
+python tools/bench_sentinel.py --smoke
 
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null

@@ -220,6 +220,7 @@ def test_tracker_live_plane_polls_and_names_straggler(monkeypatch):
         assert "rabit_straggler_lag_collectives" in text
         _, sdoc = _get(host, port, "/straggler")
         strag = json.loads(sdoc)
+        assert strag["signal"] is True
         assert strag["lagging_rank"] == 1
         assert strag["lag_collectives"] == 3
         assert len(strag["ranks"]) == 2
@@ -291,26 +292,48 @@ def test_straggler_snapshot_counter_only():
         r.count("not.collective")  # must not count toward lag
         docs[tid] = build_summary(r.snapshot(), rank=ord(tid) - ord("a"))
     snap = crossrank.straggler_snapshot(docs)
+    assert snap["signal"] is True  # a real count lag is a signal
     assert snap["lagging_rank"] == 1  # task "b"
+    assert snap["candidate_rank"] == 1
     assert snap["lag_collectives"] == 4
     assert len(snap["ranks"]) == 3
-    assert crossrank.straggler_snapshot({})["lagging_rank"] is None
+    empty = crossrank.straggler_snapshot({})
+    assert empty["lagging_rank"] is None and empty["signal"] is False
 
 
-def test_straggler_snapshot_tie_breaks_to_least_busy():
+def _tied_count_docs(busy_a, busy_b):
     # Synchronizing collectives complete in lockstep, so counts tie; the
     # real straggler arrives last and leaves at once — least busy — while
     # the waiters burn time blocked inside the collective.
     docs = {}
-    for tid, busy in (("a", 0.9), ("b", 0.1)):
+    for tid, busy in (("a", busy_a), ("b", busy_b)):
         r = Recorder(capacity=8, enabled=True)
         for _ in range(4):
             r.record_span("engine.allreduce", busy / 4, nbytes=1024)
         docs[tid] = build_summary(r.snapshot(), rank=ord(tid) - ord("a"))
-    snap = crossrank.straggler_snapshot(docs)
-    assert snap["lagging_rank"] == 1
+    return docs
+
+
+def test_straggler_snapshot_tie_within_threshold_is_no_signal():
+    # 0.8 s of busy skew is under BUSY_SKEW_SIGNAL_S: the tie-break
+    # still names a candidate, but no rank is accused
+    snap = crossrank.straggler_snapshot(_tied_count_docs(0.9, 0.1))
+    assert snap["signal"] is False
+    assert snap["lagging_rank"] is None
+    assert snap["candidate_rank"] == 1  # least busy under ties
     assert snap["lag_collectives"] == 0
     assert abs(snap["busy_skew_s"] - 0.8) < 1e-6
+
+
+def test_straggler_snapshot_tie_breaks_to_least_busy():
+    # past the skew threshold the candidate IS the accused straggler
+    snap = crossrank.straggler_snapshot(_tied_count_docs(1.6, 0.2))
+    assert snap["signal"] is True
+    assert snap["lagging_rank"] == 1
+    assert snap["candidate_rank"] == 1
+    assert snap["lag_collectives"] == 0
+    assert abs(snap["busy_skew_s"] - 1.4) < 1e-6
+    assert snap["busy_skew_s"] > crossrank.BUSY_SKEW_SIGNAL_S
 
 
 def test_collective_round_ids(telem):

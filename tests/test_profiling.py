@@ -1,0 +1,621 @@
+"""Deep profiling plane + regression sentinel contract (this PR's
+tentpole):
+
+- analytic collective cost formulas (schedule-shaped FLOPs/bytes/hops);
+- the profiler is a strict no-op when off (shared null probe, None
+  returns) and — the acceptance bar — the bucketed-MLP train-step jaxpr
+  is byte-identical with rabit_profile off vs on;
+- jit-probe hit/miss classification by compilation-cache growth;
+- device-memory sampling (live/peak/arrays) and the poller lifecycle;
+- the profile section riding build_summary into per-rank ``/metrics``
+  (all four rabit_compile_*/rabit_jit_cache_*/rabit_collective_cost_*/
+  rabit_device_mem_* families) and the tracker-style multi-source
+  fleet render with rank labels;
+- Prometheus exposition edge cases: label escaping, empty families,
+  histogram bucket cumulativity (text format 0.0.4);
+- perf history normalization (fingerprints, direction, dedupe) and the
+  MAD gate in both directions, plus the sentinel CLI smoke and
+  trace_report's bench_sentinel rendering;
+- lint T003: every exported family name is registered in
+  prom.METRIC_FAMILIES.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rabit_tpu import telemetry
+from rabit_tpu.models import mlp
+from rabit_tpu.ops.reducers import SUM
+from rabit_tpu.parallel import device_allreduce, dispatch, make_mesh
+from rabit_tpu.parallel.collectives import shard_over
+from rabit_tpu.telemetry import history, profile
+from rabit_tpu.telemetry.export import build_summary
+from rabit_tpu.telemetry.live import start_rank_server
+from rabit_tpu.telemetry.prom import (METRIC_FAMILIES, escape_label_value,
+                                      render_prometheus)
+from rabit_tpu.utils.config import Config
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NDEV = len(jax.devices())
+
+needs_mesh = pytest.mark.skipif(NDEV < 8, reason="needs 8 virtual devices")
+
+
+@pytest.fixture
+def prof():
+    """Module-level profiler enabled for one test, disabled after —
+    profiling must never leak into other tests (same contract as the
+    telem fixture)."""
+    profile.reset(enabled=True)
+    yield
+    profile.stop_poller()
+    profile.reset(enabled=False)
+
+
+@pytest.fixture
+def no_table(monkeypatch):
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", "none")
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE_MINCOUNT", raising=False)
+    dispatch.clear_cache()
+    yield
+    dispatch.clear_cache()
+
+
+# ------------------------------------------------- analytic cost model
+
+
+def test_collective_cost_bandwidth_term_is_schedule_invariant():
+    # ring/bidir/swing all ship 2*n*(p-1)/p elements; f32 itemsize 4
+    for method in ("ring", "bidir", "swing", "tree"):
+        c = profile.collective_cost(method, 1024, 4, 8)
+        assert c["flops"] == 1024 * 7 // 8
+        assert c["wire_bytes"] == int(2 * 1024 * 7 / 8 * 4)
+
+
+def test_collective_cost_hops_latency_term():
+    assert profile.collective_cost("ring", 64, 4, 8)["hops"] == 14
+    assert profile.collective_cost("bidir", 64, 4, 8)["hops"] == 14
+    assert profile.collective_cost("swing", 64, 4, 8)["hops"] == 6
+    assert profile.collective_cost("tree", 64, 4, 8)["hops"] == 6
+    # non-power-of-two rounds the log term up
+    assert profile.collective_cost("swing", 64, 4, 6)["hops"] == 6
+    assert profile.collective_cost("ring", 64, 4, 6)["hops"] == 10
+
+
+def test_collective_cost_wire_scales_bytes_not_flops():
+    f32 = profile.collective_cost("ring", 256, 4, 4)
+    bf16 = profile.collective_cost("ring", 256, 4, 4, wire="bf16")
+    int8 = profile.collective_cost("ring", 256, 4, 4, wire="int8")
+    assert bf16["wire_bytes"] == f32["wire_bytes"] // 2
+    # int8 pays one f32 scale per 256-element block on top of 1 B/elem
+    assert int8["wire_bytes"] == int(2 * 256 * 3 / 4 * (1 + 4 / 256))
+    assert f32["flops"] == bf16["flops"] == int8["flops"]
+
+
+def test_collective_cost_degenerate_worlds_are_free():
+    for kwargs in ({"axis_size": 1, "n": 100}, {"axis_size": 8, "n": 0}):
+        c = profile.collective_cost("ring", kwargs["n"], 4,
+                                    kwargs["axis_size"])
+        assert c == {"flops": 0, "wire_bytes": 0, "hops": 0}
+
+
+# ------------------------------------------------ profiler on/off gate
+
+
+def test_disabled_profiler_is_inert():
+    profile.reset(enabled=False)
+    assert profile.record_cost("allreduce", "ring", None, 64, 4, 8) is None
+    probe = profile.jit_probe("x", lambda: None)
+    assert probe.live is False
+    with probe:
+        pass
+    profile.cache_event("x", hit=True)
+    profile.record_compile("x", 1.0)
+    assert profile.sample_memory() is None
+    snap = profile.snapshot()
+    assert snap["compile"] == [] and snap["jit_cache"] == []
+    assert snap["cost"] == [] and snap["device_mem"]["samples"] == 0
+
+
+def test_disabled_probe_is_shared_not_allocated():
+    profile.reset(enabled=False)
+    a = profile.jit_probe("a", lambda: None)
+    b = profile.jit_probe("b", lambda: None)
+    assert a is b  # zero per-call allocation on the hot path
+
+
+def test_record_cost_accumulates_and_returns_estimate(prof):
+    est = profile.record_cost("allreduce", "ring", "bf16", 1024, 4, 8)
+    assert est == profile.collective_cost("ring", 1024, 4, 8, wire="bf16")
+    profile.record_cost("allreduce", "ring", "bf16", 1024, 4, 8)
+    (row,) = profile.snapshot()["cost"]
+    assert row["name"] == "allreduce" and row["method"] == "ring"
+    assert row["wire"] == "bf16" and row["count"] == 2
+    assert row["flops"] == 2 * est["flops"]
+    assert row["wire_bytes"] == 2 * est["wire_bytes"]
+
+
+# --------------------------------------------------- jit probe + cache
+
+
+class _FakeJitted:
+    """Stand-in with the jax 0.4 private cache API."""
+
+    def __init__(self):
+        self.size = 0
+
+    def _cache_size(self):
+        return self.size
+
+
+def test_jit_probe_classifies_miss_then_hit(prof):
+    fn = _FakeJitted()
+    with profile.jit_probe("tagged", fn):
+        fn.size += 1  # "compiled" inside the probe
+    with profile.jit_probe("tagged", fn):
+        pass  # cache unchanged -> hit
+    snap = profile.snapshot()
+    (cache,) = snap["jit_cache"]
+    assert cache["fn"] == "tagged"
+    assert cache["hits"] == 1 and cache["misses"] == 1
+    (comp,) = snap["compile"]
+    assert comp["fn"] == "tagged" and comp["count"] == 1
+    assert comp["total_s"] >= 0.0 and comp["max_s"] <= comp["total_s"] + 1e-9
+
+
+def test_jit_probe_without_cache_api_records_nothing(prof):
+    with profile.jit_probe("opaque", object()):
+        pass
+    snap = profile.snapshot()
+    assert snap["jit_cache"] == [] and snap["compile"] == []
+
+
+def test_jit_probe_on_real_jitted_function(prof):
+    @jax.jit
+    def f(x):
+        return x * 2.0
+
+    with profile.jit_probe("real", f):
+        f(jnp.ones(8)).block_until_ready()
+    with profile.jit_probe("real", f):
+        f(jnp.ones(8)).block_until_ready()
+    (cache,) = profile.snapshot()["jit_cache"]
+    assert cache["misses"] == 1 and cache["hits"] == 1
+    (comp,) = profile.snapshot()["compile"]
+    assert comp["count"] == 1 and comp["total_s"] > 0.0
+
+
+def test_cache_event_counts_dispatch_table_lookups(prof):
+    profile.cache_event("dispatch_table", hit=False)
+    profile.cache_event("dispatch_table", hit=True)
+    profile.cache_event("dispatch_table", hit=True)
+    (row,) = profile.snapshot()["jit_cache"]
+    assert row == {"fn": "dispatch_table", "hits": 2, "misses": 1}
+
+
+# -------------------------------------------------------- memory plane
+
+
+def test_sample_memory_counts_live_arrays(prof):
+    keep = jnp.ones((256, 256), jnp.float32)  # noqa: F841 - stays live
+    m = profile.sample_memory()
+    assert m is not None
+    assert m["live_bytes"] >= 256 * 256 * 4
+    assert m["arrays"] >= 1 and m["samples"] == 1
+    m2 = profile.sample_memory()
+    assert m2["samples"] == 2
+    assert m2["peak_bytes"] >= m["live_bytes"]  # high-water is monotonic
+
+
+def test_poller_lifecycle(prof):
+    assert profile.start_poller(interval_ms=10) is True
+    assert profile.start_poller(interval_ms=10) is True  # idempotent
+    profile.stop_poller()
+    assert profile.start_poller(interval_ms=0) is False  # disabled
+    profile.reset(enabled=False)
+    assert profile.start_poller(interval_ms=10) is False  # off -> no thread
+
+
+def test_configure_from_config(prof):
+    profile.reset(enabled=False)
+    assert profile.configure(None) is False
+    assert profile.configure(Config({})) is False  # key absent: unchanged
+    cfg = Config({"rabit_profile": "1",
+                  "rabit_profile_memory_poll_ms": "0"})
+    assert profile.configure(cfg) is True
+    assert profile.enabled()
+    assert profile.configure(Config({"rabit_profile": "0"})) is False
+    assert not profile.enabled()
+
+
+# ------------------------------------- profile section rides summaries
+
+
+def test_summary_carries_profile_section_only_when_enabled(prof):
+    profile.record_cost("allreduce", "ring", None, 64, 4, 8)
+    doc = build_summary(telemetry.snapshot(), rank=0)
+    assert "profile" in doc
+    assert doc["profile"]["cost"][0]["name"] == "allreduce"
+    profile.set_enabled(False)
+    assert "profile" not in build_summary(telemetry.snapshot(), rank=0)
+
+
+@needs_mesh
+def test_device_allreduce_stamps_cost_into_span(no_table, prof):
+    telemetry.reset(capacity=64, enabled=True)
+    try:
+        mesh = make_mesh(8)
+        xs = np.ones((8, 1000), np.float32)
+        out = device_allreduce(shard_over(mesh, xs), mesh, SUM)
+        np.testing.assert_allclose(np.asarray(out), np.full(1000, 8.0))
+        spans = [s for s in telemetry.snapshot()["spans"]
+                 if s["name"] == "allreduce"]
+        (s,) = spans
+        want = profile.collective_cost(s["method"], 1000, 4, 8)
+        assert s["attrs"]["cost_flops"] == want["flops"]
+        assert s["attrs"]["cost_wire_bytes"] == want["wire_bytes"]
+        assert s["attrs"]["cost_hops"] == want["hops"]
+        (cost,) = profile.snapshot()["cost"]
+        assert cost["name"] == "allreduce" and cost["count"] == 1
+        # the jit probe classified the call against the global jit cache
+        assert any(r["fn"] == "allreduce"
+                   for r in profile.snapshot()["jit_cache"])
+    finally:
+        telemetry.reset(enabled=False)
+
+
+_PROFILE_FAMILIES = ("rabit_compile_", "rabit_jit_cache_",
+                     "rabit_collective_cost_", "rabit_device_mem_")
+
+
+def test_rank_metrics_endpoint_serves_all_four_families(prof):
+    """Acceptance: with profiling on, a per-rank /metrics scrape carries
+    compile, jit-cache, cost, and device-memory families."""
+    telemetry.reset(capacity=64, enabled=True)
+    fn = _FakeJitted()
+    with profile.jit_probe("step", fn):
+        fn.size += 1
+    profile.record_cost("allreduce", "swing", "int8", 4096, 4, 8)
+    srv = start_rank_server(0, rank=3, world=8)
+    try:
+        with urllib.request.urlopen(
+                f"http://{srv.host}:{srv.port}/metrics", timeout=5) as r:
+            assert "version=0.0.4" in r.headers.get("Content-Type", "")
+            text = r.read().decode()
+    finally:
+        srv.stop()
+        telemetry.reset(enabled=False)
+    for prefix in _PROFILE_FAMILIES:
+        assert prefix in text, prefix
+    assert 'rabit_compile_total{rank="3",fn="step"} 1' in text
+    assert 'rabit_jit_cache_misses_total{rank="3",fn="step"} 1' in text
+    assert ('rabit_collective_cost_flops_total{rank="3",name="allreduce",'
+            'method="swing",wire="int8"}') in text
+    assert 'rabit_device_mem_live_bytes{rank="3"}' in text
+
+
+def test_fleet_render_labels_profile_families_per_rank(prof):
+    """Tracker-style merge: one source per polled rank; profile rows
+    keep their rank label so a single scrape trends every rank."""
+    profile.record_cost("allreduce", "ring", None, 64, 4, 8)
+    doc0 = build_summary(telemetry.snapshot(), rank=0)
+    profile.reset(enabled=True)
+    profile.record_cost("allreduce", "ring", None, 128, 4, 8)
+    doc1 = build_summary(telemetry.snapshot(), rank=1)
+    text = render_prometheus([({"rank": "0"}, doc0), ({"rank": "1"}, doc1)])
+    flops = profile.collective_cost("ring", 64, 4, 8)["flops"]
+    flops1 = profile.collective_cost("ring", 128, 4, 8)["flops"]
+    assert (f'rabit_collective_cost_flops_total{{rank="0",name="allreduce",'
+            f'method="ring",wire=""}} {flops}') in text
+    assert (f'rabit_collective_cost_flops_total{{rank="1",name="allreduce",'
+            f'method="ring",wire=""}} {flops1}') in text
+    # HELP/TYPE emitted once per family, not once per source
+    assert text.count("# TYPE rabit_collective_cost_flops_total") == 1
+
+
+# --------------------------------------------- jaxpr purity acceptance
+
+
+@needs_mesh
+def test_profiling_keeps_bucketed_step_jaxpr_pure(no_table):
+    """Acceptance bar: the traced jaxpr of the bucketed MLP train step
+    is IDENTICAL with rabit_profile off and on — every probe is
+    host-side, nothing is staged into the computation."""
+    from tests.test_telemetry import _prims
+
+    mesh = make_mesh(8, ("dp", "tp"), (4, 2))
+    params, x, y = mlp.make_sharded_inputs(
+        mesh, batch=16, in_dim=12, hidden=8, out_dim=4, seed=7)
+    step = mlp.make_train_step(mesh, lr=0.5, grad_sync="bucket")
+
+    def trace():
+        jax.clear_caches()
+        return _prims(jax.make_jaxpr(step)(params, x, y).jaxpr)
+
+    profile.reset(enabled=False)
+    off = trace()
+    profile.reset(enabled=True)
+    try:
+        on = trace()
+    finally:
+        profile.reset(enabled=False)
+    assert off == on
+    assert off.count("ppermute") == 6
+
+
+# ---------------------------------------- exposition format edge cases
+
+
+def test_escape_label_value_per_exposition_format():
+    assert escape_label_value('a\\b"c\nd') == 'a\\\\b\\"c\\nd'
+    assert escape_label_value("plain") == "plain"
+
+
+def test_rendered_labels_escape_hostile_values():
+    doc = {"recorded": 1, "dropped": 0,
+           "counters": [{"name": 'evil"name\\with\nnewline', "count": 1,
+                         "bytes": 0, "total_s": 0.0, "max_s": 0.0}]}
+    text = render_prometheus([({}, doc)])
+    assert 'name="evil\\"name\\\\with\\nnewline"' in text
+    # the document itself stays one-sample-per-line parseable
+    for line in text.splitlines():
+        assert line.startswith("#") or line.count(" ") >= 1
+
+
+def test_empty_counter_set_emits_no_empty_families():
+    text = render_prometheus([({}, {"recorded": 0, "dropped": 0})])
+    # occupancy families have samples; per-key and profile ones must
+    # not emit orphan HELP/TYPE headers
+    assert "rabit_telemetry_recorded_total 0" in text
+    assert "rabit_collective_total" not in text
+    assert "rabit_compile_total" not in text
+    assert render_prometheus([]).strip() == ""
+
+
+def test_histogram_buckets_are_cumulative_with_inf_and_count():
+    doc = {"recorded": 3, "dropped": 0,
+           "counters": [{"name": "allreduce", "count": 3, "bytes": 30,
+                         "total_s": 0.5, "max_s": 0.3,
+                         "hist_log2_us": {"3": 1, "1": 2}}]}
+    text = render_prometheus([({}, doc)])
+    buckets = [ln for ln in text.splitlines()
+               if ln.startswith("rabit_collective_duration_seconds_bucket")]
+    # sorted by bound, cumulative counts: 2 (le 2us), 3 (le 8us), 3 (+Inf)
+    assert [ln.rsplit(" ", 1)[1] for ln in buckets] == ["2", "3", "3"]
+    assert 'le="2e-06"' in buckets[0] and 'le="8e-06"' in buckets[1]
+    assert 'le="+Inf"' in buckets[2]
+    assert "rabit_collective_duration_seconds_count{" in text
+    assert "rabit_collective_duration_seconds_sum{" in text
+    count = [ln for ln in text.splitlines()
+             if ln.startswith("rabit_collective_duration_seconds_count")]
+    assert count[0].rsplit(" ", 1)[1] == "3"  # +Inf == _count
+
+
+# --------------------------------------------------- T003 registration
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"rabit_{name}", os.path.join(ROOT, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_metric_families_registry_is_complete_and_unique():
+    assert len(set(METRIC_FAMILIES)) == len(METRIC_FAMILIES)
+    lint = _load_tool("lint")
+    registry = lint._t003_registry()
+    assert registry == set(METRIC_FAMILIES)
+    import ast
+    for rel in lint.T003_SCAN:
+        with open(os.path.join(ROOT, rel), encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+        assert lint._t003_issues(rel, tree) == [], rel
+
+
+def test_lint_flags_unregistered_family():
+    import ast
+    lint = _load_tool("lint")
+    rel = os.path.join("rabit_tpu", "telemetry", "live.py")
+    tree = ast.parse('g = ("rabit_made_up_total", "h", "counter", [])')
+    (issue,) = lint._t003_issues(rel, tree)
+    assert issue[2] == "T003" and "rabit_made_up_total" in issue[3]
+
+
+# ---------------------------------------------- history + MAD sentinel
+
+
+def _rec(metric, value, ts, fp="cfg0", unit="GB/s", direction="higher"):
+    return {"metric": metric, "value": value, "unit": unit,
+            "direction": direction, "fingerprint": fp,
+            "timestamp_utc": ts, "source": "test"}
+
+
+def test_config_fingerprint_tracks_config_not_measurement():
+    base = {"metric": "allreduce_bw", "value": 10.0, "backend": "tpu",
+            "n": 4096, "timestamp_utc": "20260801T000000Z"}
+    fp = history.config_fingerprint(base)
+    assert fp == history.config_fingerprint(
+        dict(base, value=99.0, timestamp_utc="20260802T000000Z"))
+    assert fp != history.config_fingerprint(dict(base, backend="cpu"))
+    assert fp != history.config_fingerprint(dict(base, n=8192))
+    assert len(fp) == 12
+
+
+def test_direction_inference():
+    assert history._direction("throughput", "GB/s") == "higher"
+    assert history._direction("step_time", "ms") == "lower"
+    assert history._direction("best_step_s", "") == "lower"
+    assert history._direction("compile_seconds", "") == "lower"
+
+
+def test_extract_metrics_shapes():
+    doc = {"metric": "allreduce_bw", "value": 12.5, "unit": "GB/s",
+           "gbps": {"tpu": 40.0, "cpu": 2.0},
+           "bandwidth_vs_rows": {"1024": 5.0},
+           "best_step_s": 0.25, "correct": True}
+    got = {m["metric"]: m for m in history.extract_metrics(doc)}
+    assert got["allreduce_bw"]["value"] == 12.5
+    assert got["allreduce_bw.tpu"]["value"] == 40.0
+    assert got["allreduce_bw.rows_1024"]["value"] == 5.0
+    assert got["best_step_s"]["direction"] == "lower"
+    assert history.extract_metrics({"schema": "x", "rows": []}) == []
+    # bools are not measurements
+    assert history.extract_metrics({"metric": "m", "value": True}) == []
+
+
+def test_append_dedupes_and_load_survives_torn_writes(tmp_path):
+    path = str(tmp_path / "history.jsonl")
+    recs = [_rec("m", 1.0, "20260801T000000Z"),
+            _rec("m", 2.0, "20260801T000001Z")]
+    assert history.append(path, recs) == 2
+    assert history.append(path, recs) == 0  # dedupe on re-ingest
+    assert history.append(path, [_rec("m", 3.0, "20260801T000002Z")]) == 1
+    with open(path, "a") as f:
+        f.write('{"torn": \n')  # a crashed writer mid-line
+        f.write('not json at all\n')
+    loaded = history.load(path)
+    assert [r["value"] for r in loaded] == [1.0, 2.0, 3.0]
+    assert history.load(str(tmp_path / "missing.jsonl")) == []
+
+
+def test_gate_flags_drop_in_higher_better_metric():
+    recs = [_rec("bw", v, f"20260801T00000{i}Z")
+            for i, v in enumerate([100, 101, 99, 100, 80])]
+    (v,) = history.gate(recs, window=8, mad_k=3.0, min_samples=4)
+    assert v["regressed"] is True
+    assert v["value"] == 80.0 and v["baseline_median"] == 100.0
+    assert v["threshold"] > 80.0
+
+
+def test_gate_flags_rise_in_lower_better_metric():
+    recs = [_rec("step_s", v, f"20260801T00000{i}Z", unit="s",
+                 direction="lower")
+            for i, v in enumerate([1.0, 1.01, 0.99, 1.0, 1.5])]
+    (v,) = history.gate(recs, window=8, mad_k=3.0, min_samples=4)
+    assert v["regressed"] is True and v["value"] == 1.5
+
+
+def test_gate_within_noise_passes_and_short_series_unjudged():
+    ok = [_rec("bw", v, f"20260801T00000{i}Z")
+          for i, v in enumerate([100, 101, 99, 100, 100.5])]
+    (v,) = history.gate(ok, window=8, mad_k=3.0, min_samples=4)
+    assert v["regressed"] is False
+    short = ok[:3]
+    (v,) = history.gate(short, window=8, mad_k=3.0, min_samples=4)
+    assert v["regressed"] is None and v["n_baseline"] == 2
+
+
+def test_gate_rel_floor_absorbs_identical_baselines():
+    # MAD 0 history: the 1% floor keeps sub-percent wiggle from flagging
+    recs = [_rec("bw", 100.0, f"20260801T00000{i}Z") for i in range(5)]
+    recs.append(_rec("bw", 99.5, "20260801T000005Z"))
+    (v,) = history.gate(recs, window=8, mad_k=3.0, min_samples=4)
+    assert v["regressed"] is False
+    recs.append(_rec("bw", 90.0, "20260801T000006Z"))
+    (v,) = history.gate(recs, window=8, mad_k=3.0, min_samples=4)
+    assert v["regressed"] is True
+
+
+def test_gate_separates_fingerprints():
+    recs = [_rec("bw", v, f"20260801T00000{i}Z", fp="tpu")
+            for i, v in enumerate([100, 101, 99, 100, 100])]
+    recs += [_rec("bw", v, f"20260801T00000{i}Z", fp="cpu")
+             for i, v in enumerate([10, 10, 10, 10, 2])]
+    verdicts = {v["fingerprint"]: v
+                for v in history.gate(recs, window=8, mad_k=3.0,
+                                      min_samples=4)}
+    assert verdicts["tpu"]["regressed"] is False
+    assert verdicts["cpu"]["regressed"] is True
+
+
+def test_verdict_doc_schema_and_sentinel_cli(tmp_path):
+    doc = history.verdict_doc(history.gate([]), window=8, mad_k=3.0)
+    assert doc["schema"] == "rabit_tpu.bench_sentinel/v1"
+    assert doc["checked"] == 0 and doc["regressions"] == 0
+    # the CLI smoke: clean pass AND injected 3x-MAD drop caught
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_sentinel.py"),
+         "--smoke"],
+        capture_output=True, text=True, timeout=120, cwd=ROOT,
+        env=dict(os.environ, PYTHONPATH=ROOT))
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "bench sentinel smoke ok" in out.stdout
+
+
+def test_sentinel_cli_exits_nonzero_on_regression(tmp_path):
+    hist = str(tmp_path / "history.jsonl")
+    recs = [_rec("bw", v, f"20260801T00000{i}Z")
+            for i, v in enumerate([100, 101, 99, 100, 70])]
+    history.append(hist, recs)
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "bench_sentinel.py"),
+         "--no-ingest", "--history", hist,
+         "--out", str(tmp_path / "verdict.json")],
+        capture_output=True, text=True, timeout=120, cwd=ROOT,
+        env=dict(os.environ, PYTHONPATH=ROOT))
+    assert out.returncode == 1
+    assert "REGRESSION bw" in out.stderr
+    with open(tmp_path / "verdict.json") as f:
+        verdict = json.load(f)
+    assert verdict["regressions"] == 1
+
+
+def test_bench_auto_appends_history(tmp_path, monkeypatch):
+    """bench.py's artifact writer feeds the history (the sentinel's
+    ingest source of truth) — simulated at module level to stay fast."""
+    doc = {"metric": "toy_mlp_allreduce_throughput", "value": 3.5,
+           "unit": "GB/s", "backend": "cpu", "n": 4096,
+           "timestamp_utc": "20260805T000000Z"}
+    hist = str(tmp_path / "history.jsonl")
+    recs = history.records_from_artifact(doc, source="BENCH_LOCAL_x.json")
+    assert history.append(hist, recs) == 1
+    (rec,) = history.load(hist)
+    assert rec["source"] == "BENCH_LOCAL_x.json"
+    assert rec["fingerprint"] == history.config_fingerprint(doc)
+
+
+# ------------------------------------------------ trace_report surface
+
+
+def test_trace_report_renders_sentinel_trend_table(tmp_path):
+    tr = _load_tool("trace_report")
+    recs = [_rec("bw", v, f"20260801T00000{i}Z")
+            for i, v in enumerate([100, 101, 99, 100, 80])]
+    doc = history.verdict_doc(history.gate(recs), window=8, mad_k=3.0)
+    assert tr.recognized(doc)
+    text = tr.render_sentinel(doc)
+    assert "**REGRESSED**" in text and "bw" in text
+    clean = history.verdict_doc(history.gate(recs[:3]))
+    assert "no gate" in tr.render_sentinel(clean)
+
+
+def test_trace_report_dir_mode_renders_and_skips(tmp_path):
+    d = tmp_path / "arts"
+    d.mkdir()
+    recs = [_rec("bw", v, f"20260801T00000{i}Z")
+            for i, v in enumerate([100, 101, 99, 100, 100])]
+    doc = history.verdict_doc(history.gate(recs))
+    (d / "SENTINEL.json").write_text(json.dumps(doc))
+    (d / "unrelated.json").write_text('{"no": "schema"}')
+    (d / "broken.json").write_text("{")
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "trace_report.py"),
+         "--dir", str(d)],
+        capture_output=True, text=True, timeout=120, cwd=ROOT,
+        env=dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, (out.stdout, out.stderr)
+    assert "bw" in out.stdout
+    assert "skipped 2" in out.stdout

@@ -120,7 +120,9 @@ def main() -> None:
         return
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y%m%dT%H%M%SZ")
-    path = os.path.join(REPO, f"BOOSTED_BENCH_{ts}.json")
+    out_dir = os.path.join(REPO, "benchmarks", "artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"BOOSTED_BENCH_{ts}.json")
     with open(path, "w") as f:
         json.dump({"benchmark": "end-to-end gradient-boosting round: "
                                 "8 host workers (build + socket "

@@ -53,8 +53,16 @@ KNOWN = ("kernel_hw", "hist_sweep", "boosted_tpu", "flagship_flash",
 
 
 def _arts(prefix):
+    # evidence lives under benchmarks/artifacts/; the repo root is
+    # still scanned so pre-move checkouts (and tests that drop files
+    # straight into a tmp REPO) keep working
     out = []
-    for p in sorted(glob.glob(os.path.join(REPO, f"{prefix}_*.json"))):
+    paths = sorted(
+        glob.glob(os.path.join(REPO, "benchmarks", "artifacts",
+                               f"{prefix}_*.json"))
+        + glob.glob(os.path.join(REPO, f"{prefix}_*.json")),
+        key=os.path.basename)
+    for p in paths:
         try:
             with open(p) as f:
                 out.append(json.load(f))

@@ -211,7 +211,9 @@ def main() -> None:
     result["timestamp_utc"] = ts
     if args.smoke:
         result["smoke"] = True  # noisy timings: never commit one of these
-    path = args.out or os.path.join(REPO, f"COLLECTIVE_SWEEP_{ts}.json")
+    out_dir = os.path.join(REPO, "benchmarks", "artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    path = args.out or os.path.join(out_dir, f"COLLECTIVE_SWEEP_{ts}.json")
     with open(path, "w") as f:
         json.dump(result, f, indent=1)
     print(f"wrote {path}")

@@ -80,7 +80,9 @@ def main() -> None:
         "best_step_s": round(t_steady, 3),
         "timestamp_utc": ts,
     }
-    path = os.path.join(_REPO, f"FLAGSHIP_HW_{ts}.json")
+    out_dir = os.path.join(_REPO, "benchmarks", "artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"FLAGSHIP_HW_{ts}.json")
     with open(path, "w") as f:
         json.dump(payload, f, indent=1)
         f.write("\n")

@@ -163,7 +163,9 @@ def main() -> None:
         return
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y%m%dT%H%M%SZ")
-    path = os.path.join(_REPO, f"HIST_SWEEP_{ts}.json")
+    out_dir = os.path.join(_REPO, "benchmarks", "artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"HIST_SWEEP_{ts}.json")
     with open(path, "w") as f:
         json.dump({"backend": backend, "device": str(jax.devices()[0]),
                    "measurement": f"slope K={K_SMALL}->{K_BIG} over a "

@@ -52,7 +52,9 @@ def main() -> None:
     # artifact).
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y%m%dT%H%M%SZ")
-    path = os.path.join(_REPO, f"KERNEL_HW_{ts}.json")
+    out_dir = os.path.join(_REPO, "benchmarks", "artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"KERNEL_HW_{ts}.json")
 
     def flush():
         if smoke:  # CI must not shed artifacts into the repo

@@ -25,6 +25,14 @@ this implements the highest-signal subset with only the stdlib:
   (``telemetry.count(...)`` / ``record_span`` / ``record_dispatch``) —
   an uncounted escalation is invisible to fleet tables, the live
   ``/metrics`` endpoints, and post-mortem flight bundles.
+- **metric-family registration** (T003, repo-specific): every
+  ``/metrics`` family name minted anywhere in the telemetry/engine/
+  tracker code (a ``_Family("rabit_...", ...)`` construction or a
+  gauge-spec tuple ``("rabit_...", help, "counter"|"gauge"|...)``)
+  must appear in the ``METRIC_FAMILIES`` table in
+  ``rabit_tpu/telemetry/prom.py`` — one place to see the full
+  exposition surface, so a new family can't ship undocumented or
+  collide with an existing name spelled slightly differently.
 - **unretried control-plane sockets** (R001, repo-specific): raw
   ``socket.socket(...)`` / ``socket.create_connection(...)`` calls
   inside ``rabit_tpu/`` must go through ``utils/retry.py``
@@ -90,6 +98,86 @@ R001_ALLOWED = {
 }
 
 _R001_CALLS = {"socket", "create_connection"}
+
+# T003: files that mint /metrics family names. Every name found here
+# (via _t003_minted_names) must be registered in prom.py's
+# METRIC_FAMILIES table.
+T003_SCAN = (
+    os.path.join("rabit_tpu", "telemetry", "prom.py"),
+    os.path.join("rabit_tpu", "telemetry", "live.py"),
+    os.path.join("rabit_tpu", "telemetry", "profile.py"),
+    os.path.join("rabit_tpu", "tracker", "tracker.py"),
+    os.path.join("rabit_tpu", "engine", "xla.py"),
+    os.path.join("rabit_tpu", "engine", "native.py"),
+)
+
+_T003_TYPES = {"counter", "gauge", "histogram"}
+
+
+def _t003_registry():
+    """METRIC_FAMILIES entries parsed from prom.py's AST (never
+    imported — lint must not execute repo code)."""
+    path = os.path.join(REPO, "rabit_tpu", "telemetry", "prom.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "METRIC_FAMILIES"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return None
+
+
+def _t003_minted_names(tree):
+    """(name, lineno) for every family minted in this module: a
+    ``_Family("rabit_...", ...)`` construction, or a gauge-spec tuple
+    whose first element is a ``rabit_``-prefixed string and whose
+    third is a Prometheus type keyword."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname == "_Family" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str) and \
+                    node.args[0].value.startswith("rabit_"):
+                out.append((node.args[0].value, node.lineno))
+        elif isinstance(node, ast.Tuple) and len(node.elts) >= 3:
+            head, third = node.elts[0], node.elts[2]
+            if isinstance(head, ast.Constant) and \
+                    isinstance(head.value, str) and \
+                    head.value.startswith("rabit_") and \
+                    isinstance(third, ast.Constant) and \
+                    third.value in _T003_TYPES:
+                out.append((head.value, node.lineno))
+    return out
+
+
+def _t003_issues(rel, tree):
+    if rel not in T003_SCAN:
+        return []
+    minted = _t003_minted_names(tree)
+    if not minted:
+        return []
+    registry = _t003_registry()
+    if registry is None:
+        return [(rel, 1, "T003",
+                 "cannot parse METRIC_FAMILIES from "
+                 "rabit_tpu/telemetry/prom.py")]
+    return [(rel, line, "T003",
+             f"metrics family '{name}' not registered in "
+             "METRIC_FAMILIES (rabit_tpu/telemetry/prom.py)")
+            for name, line in minted if name not in registry]
 
 
 def _r001_issues(rel, tree, src):
@@ -232,6 +320,7 @@ def check_file(path: str):
                 issues.append((rel, node.lineno, "F401",
                                f"'{shown}' imported but unused"))
     issues.extend(_r001_issues(rel, tree, src))
+    issues.extend(_t003_issues(rel, tree))
     required = SPAN_REQUIRED.get(rel)
     if required:
         seen = set()

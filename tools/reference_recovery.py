@@ -114,7 +114,9 @@ def main() -> int:
         return 1 if failed else 0
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y%m%dT%H%M%SZ")
-    path = os.path.join(REPO, f"REF_RECOVER_{ts}.json")
+    out_dir = os.path.join(REPO, "benchmarks", "artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"REF_RECOVER_{ts}.json")
     with open(path, "w") as f:
         json.dump({
             "benchmark": "reference test/{model,local,lazy}_recover.cc "

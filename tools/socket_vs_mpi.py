@@ -124,7 +124,9 @@ def main() -> None:
                 "multi-core/multi-host MPI on this image)",
         "rows": rows,
     }
-    path = os.path.join(REPO, f"SOCKET_VS_MPI_{ts}.json")
+    out_dir = os.path.join(REPO, "benchmarks", "artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"SOCKET_VS_MPI_{ts}.json")
     with open(path, "w") as f:
         json.dump(artifact, f, indent=1)
     print(f"wrote {path}")

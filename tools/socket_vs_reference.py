@@ -213,7 +213,9 @@ def main() -> None:
         "rows": rows,
         "timestamp_utc": ts,
     }
-    path = os.path.join(REPO, f"SOCKET_VS_REF_{ts}.json")
+    out_dir = os.path.join(REPO, "benchmarks", "artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"SOCKET_VS_REF_{ts}.json")
     with open(path, "w") as f:
         json.dump(payload, f, indent=1)
         f.write("\n")

@@ -12,8 +12,12 @@ schema-versioned artifact the repo emits —
 - ``rabit_tpu.collective_sweep/v1``  (dispatch-table artifacts)
 - ``rabit_tpu.flight_record/v1``     (crash flight-recorder bundles —
   last spans, noted wire/chaos events, per-thread stacks)
+- ``rabit_tpu.bench_sentinel/v1``    (regression-sentinel verdicts —
+  per-metric trend table, tools/bench_sentinel.py)
 
 — and it prints a GitHub-markdown table ready to paste into PERF.md.
+``--dir PATH`` renders every recognized artifact in a directory in one
+invocation (unrecognized files are listed and skipped).
 
 Given MULTIPLE artifacts whose spans carry collective round ids
 (traces, flight bundles, raw snapshots — one per rank), the report
@@ -28,7 +32,8 @@ agree with the trace events. Prints ``telemetry smoke ok`` on success.
 
 Usage:
   python tools/trace_report.py ARTIFACT.json
-  python tools/trace_report.py --smoke [--dir DIR]
+  python tools/trace_report.py --dir benchmarks/artifacts
+  python tools/trace_report.py --smoke
 """
 
 import argparse
@@ -203,6 +208,47 @@ def render_skew(docs):
     return out
 
 
+def render_sentinel(doc):
+    """bench_sentinel verdict: the PERF.md trend table — newest value
+    per (metric, config) against its rolling MAD baseline."""
+    rows = []
+    for v in doc.get("verdicts", []):
+        if v.get("regressed") is None:
+            verdict = f"no gate ({v.get('n_baseline', 0)} baseline)"
+        elif v["regressed"]:
+            verdict = "**REGRESSED**"
+        else:
+            verdict = "ok"
+        med = v.get("baseline_median")
+        thr = v.get("threshold")
+        trend = " → ".join(f"{x:g}" for x in v.get("recent", []))
+        rows.append((v.get("metric", "?"), v.get("fingerprint", ""),
+                     f"{v.get('value', 0):g} {v.get('unit', '')}".strip(),
+                     "-" if med is None else f"{med:g}",
+                     "-" if thr is None else f"{thr:g}",
+                     v.get("direction", ""), trend or "-", verdict))
+    title = (f"Regression sentinel — {doc.get('checked', 0)} series "
+             f"checked, {doc.get('regressions', 0)} regression(s) "
+             f"(window {doc.get('window', '?')}, "
+             f"{doc.get('mad_k', '?')}×MAD gate, "
+             f"{doc.get('timestamp_utc', '')})")
+    return title + "\n\n" + _md_table(
+        ("metric", "config", "latest", "baseline median", "threshold",
+         "better", "trend", "verdict"), rows)
+
+
+_KINDS = ("telemetry_summary", "telemetry_fleet", "telemetry_trace",
+          "flight_record", "bench_sentinel")
+
+
+def recognized(doc):
+    """True when :func:`render` can handle this document."""
+    if not isinstance(doc, dict):
+        return False
+    return (any(matches(doc, k) for k in _KINDS)
+            or doc.get("schema") == "rabit_tpu.collective_sweep/v1")
+
+
 def render(doc):
     if matches(doc, "telemetry_summary") or matches(doc, "telemetry_fleet"):
         return render_counters(doc)
@@ -210,6 +256,8 @@ def render(doc):
         return render_trace(doc)
     if matches(doc, "flight_record"):
         return render_flight(doc)
+    if matches(doc, "bench_sentinel"):
+        return render_sentinel(doc)
     if doc.get("schema") == "rabit_tpu.collective_sweep/v1":
         return render_sweep(doc)
     raise SystemExit(f"unrecognized artifact schema {doc.get('schema')!r}")
@@ -289,20 +337,42 @@ def main():
                     "round-carrying ones add a cross-rank skew section")
     ap.add_argument("--smoke", action="store_true",
                     help="record->export->render round-trip (CI contract)")
-    ap.add_argument("--dir", default="/tmp/rabit_telemetry_smoke",
-                    help="output dir for --smoke artifacts")
+    ap.add_argument("--dir", default=None,
+                    help="render every recognized *.json artifact in "
+                         "this directory (with --smoke: the smoke "
+                         "output dir, default /tmp/rabit_telemetry_smoke)")
     args = ap.parse_args()
     if args.smoke:
-        smoke(args.dir)
+        smoke(args.dir or "/tmp/rabit_telemetry_smoke")
         return 0
-    if not args.artifact:
-        ap.error("need an artifact path (or --smoke)")
+    paths = list(args.artifact)
+    if args.dir:
+        import glob
+        paths.extend(sorted(glob.glob(os.path.join(args.dir, "*.json"))))
+    if not paths:
+        ap.error("need an artifact path, --dir, or --smoke")
     docs = []
-    for path in args.artifact:
-        with open(path) as f:
-            doc = json.load(f)
+    skipped = []
+    for path in paths:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            if not args.dir:
+                raise
+            skipped.append((path, f"unreadable: {e}"))
+            continue
+        if args.dir and not recognized(doc):
+            # a directory scan keeps going past foreign files; an
+            # explicit file argument still fails loudly in render()
+            skipped.append((path, f"schema {doc.get('schema')!r}"))
+            continue
         docs.append(doc)
         print(render(doc))
+        print()
+    if skipped:
+        print(f"(skipped {len(skipped)} unrecognized file(s): "
+              + ", ".join(os.path.basename(p) for p, _ in skipped) + ")")
         print()
     if len(docs) >= 2:
         skew = render_skew(docs)

@@ -119,7 +119,9 @@ def run_tpu(smoke: bool) -> list:
 def _write(result: dict) -> None:
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(
         "%Y%m%dT%H%M%SZ")
-    path = os.path.join(REPO, f"WIRE_BENCH_{ts}.json")
+    out_dir = os.path.join(REPO, "benchmarks", "artifacts")
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, f"WIRE_BENCH_{ts}.json")
     with open(path, "w") as f:
         json.dump(dict(result, timestamp_utc=ts), f, indent=1)
     print(f"wrote {path}")
@@ -169,7 +171,11 @@ def main() -> None:
         # capture forward so every artifact is self-contained, and say
         # where it came from
         import glob
-        prev = sorted(glob.glob(os.path.join(REPO, "WIRE_BENCH_*.json")))
+        prev = sorted(
+            glob.glob(os.path.join(REPO, "benchmarks", "artifacts",
+                                   "WIRE_BENCH_*.json"))
+            + glob.glob(os.path.join(REPO, "WIRE_BENCH_*.json")),
+            key=os.path.basename)
         for path in reversed(prev):
             with open(path) as f:
                 old = json.load(f)
