@@ -208,6 +208,54 @@ def allreduce(data: np.ndarray, op: int,
     return buf.reshape(shape)
 
 
+def reduce_scatter(data: np.ndarray, op: int) -> np.ndarray:
+    """Reduce ``data`` elementwise across ranks and return only this
+    rank's chunk — a 1-D array of ``data.size / world_size`` elements
+    starting at ``rank * data.size / world_size`` (rank i owns chunk i,
+    the ring engine's ownership convention, allreduce_base.cc:829-918).
+
+    First-class primitive (with :func:`allgather`) of the collective
+    substrate: ``allreduce = reduce_scatter ∘ allgather``, and the
+    hierarchical schedule composes them across topology levels
+    (doc/collectives.md). ``data.size`` must divide by the world size —
+    primitives never pad silently; :func:`allreduce` is the
+    pad-and-slice convenience.
+    """
+    if not isinstance(data, np.ndarray):
+        raise TypeError("reduce_scatter only takes numpy.ndarray")
+    if np.dtype(data.dtype) not in DTYPE_ENUM:
+        raise TypeError(f"dtype {data.dtype} not supported")
+    if op not in OP_NAMES:
+        raise ValueError(f"unknown op {op}")
+    if not is_valid_op_dtype(op, data.dtype):
+        raise TypeError(
+            f"op {OP_NAMES[op]} is not defined for dtype {data.dtype} "
+            "(reference rejects BitOR on floats, c_api.cc:26-35)")
+    eng = _require_engine()
+    if data.size % eng.world_size:
+        raise ValueError(
+            f"reduce_scatter payload of {data.size} elements must divide "
+            f"by the world size {eng.world_size} (rank i owns chunk i)")
+    buf = data.flatten()  # contiguous 1-D copy, never aliases data
+    return eng.reduce_scatter(buf, op)
+
+
+def allgather(data: np.ndarray) -> np.ndarray:
+    """Concatenate every rank's ``data`` (flattened, same size on every
+    rank) in rank order; every rank returns the full 1-D result of
+    ``world_size * data.size`` elements (TryAllgatherRing,
+    allreduce_base.cc:751-815) — the inverse of
+    :func:`reduce_scatter`'s ownership layout.
+    """
+    if not isinstance(data, np.ndarray):
+        raise TypeError("allgather only takes numpy.ndarray")
+    if np.dtype(data.dtype) not in DTYPE_ENUM:
+        raise TypeError(f"dtype {data.dtype} not supported")
+    eng = _require_engine()
+    buf = data.flatten()
+    return eng.allgather(buf)
+
+
 def broadcast(data: Any, root: int) -> Any:
     """Broadcast a picklable object from ``root`` to every worker
     (rabit.py:171-206: two-phase length-then-payload broadcast)."""
@@ -275,7 +323,8 @@ def init_after_exception() -> None:
 
 __all__ = [
     "init", "finalize", "get_rank", "get_world_size", "is_distributed",
-    "get_processor_name", "tracker_print", "allreduce", "broadcast",
+    "get_processor_name", "tracker_print", "allreduce", "reduce_scatter",
+    "allgather", "broadcast",
     "load_checkpoint", "checkpoint", "lazy_checkpoint", "version_number",
     "init_after_exception",
     "MAX", "MIN", "SUM", "BITOR",

@@ -47,6 +47,51 @@ class Engine(ABC):
         pass ``None``. Handles the size pre-broadcast internally
         (rabit-inl.h:130-165)."""
 
+    def reduce_scatter(self, buf: np.ndarray, op: int) -> np.ndarray:
+        """Reduce ``buf`` elementwise across ranks and return this
+        rank's chunk — ``n/p`` elements starting at ``rank*n/p`` (rank i
+        owns chunk i, the ring engine's ownership convention,
+        allreduce_base.cc:829-918). ``buf.size`` must divide by the
+        world size. Default composition: a full allreduce (``buf`` is
+        mutated to the complete reduction, per the in-place contract)
+        followed by a slice copy; device-mesh engines override with a
+        true ring reduce-scatter that ships 1/p of the bytes."""
+        from .. import telemetry
+        p = self.world_size
+        if buf.size % p:
+            raise ValueError(
+                f"reduce_scatter payload of {buf.size} elements must "
+                f"divide by the world size {p} (rank i owns chunk i)")
+        with telemetry.span("engine.reduce_scatter", nbytes=buf.nbytes,
+                            method="allreduce",
+                            round=telemetry.collective_round(
+                                "engine.reduce_scatter")):
+            self.allreduce(buf, op)
+            m = buf.size // p
+            return buf[self.rank * m:(self.rank + 1) * m].copy()
+
+    def allgather(self, buf: np.ndarray) -> np.ndarray:
+        """Concatenate every rank's ``buf`` in rank order; every rank
+        returns the full length ``p*m`` result (TryAllgatherRing,
+        allreduce_base.cc:751-815) — the inverse of
+        :meth:`reduce_scatter`'s ownership layout. ``buf`` must be the
+        same size on every rank. Default composition: zero-pad into the
+        owned slot and SUM-allreduce (exact — every other slot is
+        zero); device-mesh engines override with a true ring
+        all-gather."""
+        from .. import telemetry
+        from ..ops.reducers import SUM
+        p = self.world_size
+        m = buf.size
+        out = np.zeros(p * m, dtype=buf.dtype)
+        out[self.rank * m:(self.rank + 1) * m] = buf.reshape(-1)
+        with telemetry.span("engine.allgather", nbytes=out.nbytes,
+                            method="allreduce",
+                            round=telemetry.collective_round(
+                                "engine.allgather")):
+            self.allreduce(out, SUM)
+        return out
+
     # -- checkpointing ----------------------------------------------------
     def load_checkpoint(self, with_local: bool = False
                         ) -> Tuple[int, Optional[bytes], Optional[bytes]]:

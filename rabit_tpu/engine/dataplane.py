@@ -59,32 +59,29 @@ from .. import telemetry
 from ..ops.reducers import DTYPE_ENUM, OP_NAMES
 
 
+def _experimental_enable_x64():
+    from jax.experimental import enable_x64
+    return enable_x64()
+
+
 def _require_private_api():
     """The data plane rides jaxlib private APIs
-    (``jax._src.distributed.global_state``,
-    ``jax._src.lib._jax.get_distributed_runtime_client``), necessary
-    because the public ``jax.distributed.initialize`` client LOG(FATAL)s
-    the process on peer death (see module docstring). The contract is
-    verified against jax/jaxlib 0.9.x. Check at construction — a jax
-    upgrade that removed them must fail loudly here, not mid-recovery
-    (VERDICT r2 weak #7)."""
+    (``jax._src.distributed.global_state`` plus the distributed-runtime
+    client), necessary because the public ``jax.distributed.initialize``
+    client LOG(FATAL)s the process on peer death (see module
+    docstring). The client bindings moved between jax 0.4.x and 0.9.x;
+    ``utils/jaxcompat.py`` owns the probe and kwarg translation. Check
+    at construction — a jax upgrade that removed them must fail loudly
+    here, not mid-recovery (VERDICT r2 weak #7)."""
     try:
-        from jax._src.lib import _jax
         from jax._src.distributed import global_state  # noqa: F401
     except ImportError as e:
         raise RuntimeError(
             "rabit_tpu's XLA data plane requires jax private modules "
-            "(jax._src.distributed / jax._src.lib) — verified against "
-            "jax 0.9.x; this jax build lacks them") from e
-    missing = [n for n in ("get_distributed_runtime_client",)
-               if not hasattr(_jax, n)]
-    if missing:
-        import jaxlib
-        raise RuntimeError(
-            f"jaxlib private API {missing} missing in jaxlib "
-            f"{getattr(jaxlib, '__version__', '?')} — the XLA data "
-            "plane's client contract is verified against jaxlib 0.9.x; "
-            "pin jaxlib or run without rabit_dataplane=xla")
+            "(jax._src.distributed) — verified against jax 0.4.x and "
+            "0.9.x; this jax build lacks them") from e
+    from ..utils import jaxcompat
+    jaxcompat.distributed_runtime_module()
 
 # C hook signature (native/include/rabit_tpu_c.h RbtDataPlaneFn)
 DATAPLANE_CB = ctypes.CFUNCTYPE(
@@ -170,10 +167,11 @@ class XlaDataPlane:
             # C++-side reference keeps the error-poll thread alive as a
             # zombie, and whenever its (reaped or stopping) service
             # cancels the poll, client.h LOG(FATAL)s this process.
-            # client.shutdown() cancels the poll and — because the task
-            # is recoverable — returns immediately without barriering on
-            # dead peers; the tracker-hosted service it talks to outlives
-            # every worker by design.
+            # client.shutdown() cancels the poll and returns promptly —
+            # recoverable tasks skip the peer barrier, and on jaxlibs
+            # without the recoverable flag the 1s shutdown_timeout
+            # (utils/jaxcompat.py) bounds it; the tracker-hosted service
+            # it talks to outlives every worker by design.
             try:
                 client.shutdown()
             except Exception as e:  # noqa: BLE001 - service may be gone
@@ -198,7 +196,8 @@ class XlaDataPlane:
     def _form_world(self, epoch: int) -> None:
         import jax
         from jax._src.distributed import global_state
-        from jax._src.lib import _jax
+
+        from ..utils import jaxcompat
         # recovery accounting: a re-formation in a process that already
         # had a world means the epoch advanced under it (a peer died and
         # the fleet rewired); the span carries how long the device world
@@ -219,26 +218,22 @@ class XlaDataPlane:
                 "(launch with coordinator hosting enabled — "
                 "rabit_dataplane=xla in the worker command or "
                 "RABIT_DATAPLANE=xla in the environment)")
-        # huge heartbeat timeout, on purpose: failure detection belongs
+        # huge heartbeat budget, on purpose: failure detection belongs
         # to the socket control plane. The jaxlib agent's watchdogs
         # (missed heartbeats, error polling) LOG(FATAL) the process —
         # one peer's death would take every survivor with it, the exact
         # failure the robust engine exists to absorb. A Python
         # missed_heartbeat_callback is no escape: invoking it aborts via
         # std::bad_cast in this jaxlib.
-        # recoverable=True is load-bearing: it marks the task recoverable
-        # in the coordination service, which then does NOT propagate this
-        # task's disconnect as a fatal error to peers still polling —
-        # without it, any non-simultaneous client teardown (recovery,
-        # staggered process exit) LOG(FATAL)s the laggards.
-        client = _jax.get_distributed_runtime_client(
-            addr, self._rank,
-            init_timeout=self._init_timeout,
-            heartbeat_timeout=1 << 20,
-            shutdown_on_destruction=False,
-            use_compression=True,
-            recoverable=True)
-        client.connect()
+        # recoverable=True (where this jaxlib has it) is load-bearing:
+        # it marks the task recoverable in the coordination service,
+        # which then does NOT propagate this task's disconnect as a
+        # fatal error to peers still polling — without it, any
+        # non-simultaneous client teardown (recovery, staggered process
+        # exit) LOG(FATAL)s the laggards. jaxcompat translates the
+        # kwargs per jaxlib generation and connects.
+        client = jaxcompat.connect_client(addr, self._rank,
+                                          self._init_timeout)
         global_state.client = client
         global_state.process_id = self._rank
         global_state.num_processes = self._world
@@ -322,6 +317,7 @@ class XlaDataPlane:
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         from ..parallel.collectives import device_allreduce
+        from ..parallel.dispatch import wire_mincount as _wire_mincount
         if self._world == 1:
             return
         mesh = self._mesh
@@ -336,18 +332,37 @@ class XlaDataPlane:
             or "off",
             round=telemetry.collective_round("dataplane.allreduce"))
         # 64-bit payloads: without x64 device_put truncates to 32 bits
-        ctx = jax.enable_x64(True) if buf.dtype.itemsize == 8 \
-            else contextlib.nullcontext()
+        # (jax.enable_x64 is the >=0.9 spelling; 0.4.x has the same
+        # context manager under jax.experimental)
+        if buf.dtype.itemsize == 8:
+            ctx = (jax.enable_x64(True) if hasattr(jax, "enable_x64")
+                   else _experimental_enable_x64())
+        else:
+            ctx = contextlib.nullcontext()
         with sp, ctx:
             sharding = NamedSharding(mesh, P("proc"))
             local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
             xs = jax.make_array_from_single_device_arrays(
                 (self._world, n), sharding, [local])
-            # wire="auto": the env-requested wire engages only at sizes
-            # where measurement says it pays (explicit per-call wire=
-            # in the collectives API still forces it)
-            out = device_allreduce(xs, mesh, op, axis="proc",
-                                   method=self._method, wire="auto")
+            if self._method == "hier":
+                # phase-decomposed two-level schedule; the host grouping
+                # comes from RABIT_HIER_GROUP (exported by the native
+                # launcher from tracker topology, or set explicitly).
+                # No phase_guard here: stall policing on this path is
+                # the C++ control plane's watchdog around the whole
+                # callback, and a failure in any phase returns nonzero
+                # to C++ -> link reset -> replay, same as the flat path.
+                from ..parallel.collectives import device_hier_allreduce
+                wire = self._wire if (self._wire and n >= _wire_mincount()) \
+                    else None
+                out = device_hier_allreduce(xs, mesh, op, axis="proc",
+                                            wire=wire)
+            else:
+                # wire="auto": the env-requested wire engages only at
+                # sizes where measurement says it pays (explicit
+                # per-call wire= in the collectives API still forces it)
+                out = device_allreduce(xs, mesh, op, axis="proc",
+                                       method=self._method, wire="auto")
             res = np.asarray(out.addressable_data(0)).reshape(-1)
         if res.dtype != buf.dtype:
             raise TypeError(

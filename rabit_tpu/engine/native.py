@@ -243,6 +243,7 @@ class NativeEngine(Engine):
                              cfg.get("rabit_dataplane_wire_mincount", ""))
             self._export_env("RABIT_REDUCE_METHOD",
                              cfg.get("rabit_reduce_method", ""))
+            self._export_hier_topology(cfg)
             self._dataplane = XlaDataPlane(
                 self._lib,
                 init_timeout=cfg.get_int("rabit_dataplane_init_timeout", 60))
@@ -252,6 +253,31 @@ class NativeEngine(Engine):
                 "set_dataplane")
         elif kind not in (None, "", "xla", "none"):
             raise ValueError(f"unknown rabit_dataplane {kind!r}")
+
+    def _export_hier_topology(self, cfg) -> None:
+        """Hierarchical-schedule knobs -> env for the XLA data plane.
+        An explicit ``rabit_hier_group`` wins; otherwise ask the tracker
+        for its host grouping (the ``topo`` command, computed from the
+        same endpoint fingerprints that drive UDS pairing) and export it
+        as a group spec. Only a genuinely two-level grouping (>1 host,
+        >1 rank/host, uniform) is exported — degenerate worlds keep the
+        flat schedules. Best-effort: an unreachable tracker or a topo
+        from a different epoch leaves hierarchy off, never fails init."""
+        # jax-free module, but imported lazily anyway: this runs only on
+        # the dataplane=xla path where jax is about to load regardless
+        from ..parallel import topology
+        self._export_env("RABIT_HIER", cfg.get("rabit_hier", ""))
+        group = cfg.get("rabit_hier_group", "")
+        if not group and topology.hier_enabled():
+            host = cfg.get("rabit_tracker_uri")
+            port = cfg.get_int("rabit_tracker_port", 0)
+            if host and port:
+                groups = topology.fetch_topo(
+                    host, port, task_id=cfg.get("rabit_task_id", "0") or "0")
+                if groups is not None and topology.is_hierarchical(
+                        groups, self.world_size):
+                    group = topology.groups_spec(groups)
+        self._export_env("RABIT_HIER_GROUP", group)
 
     def _start_live_plane(self, cfg) -> None:
         """Live observability: per-rank metrics endpoint, off unless

@@ -60,6 +60,8 @@ class XlaEngine(Engine):
         self._wire: Optional[str] = None
         self._wire_mincount = 0
         self._debug = False
+        self._groups = None
+        self._hier_scale = 1.0
         self._watchdog = Watchdog()  # disabled until init reads config
         self._store: Optional[ckpt_store.CheckpointStore] = None
         # live observability plane (off by default, see engine/native.py)
@@ -111,6 +113,18 @@ class XlaEngine(Engine):
         self._wire_mincount = cfg.get_size(
             "rabit_dataplane_wire_mincount",
             _dispatch.WIRE_MINCOUNT_DEFAULT)
+        # hierarchical schedule: resolve the host grouping once at init
+        # (explicit rabit_hier_group spec beats the RABIT_HIER_GROUP env
+        # the native launcher exports from tracker topology); per-phase
+        # watchdog deadlines scale by rabit_hier_phase_deadline_scale —
+        # each phase moves ~1/g (intra) or ~1/H (inter) of the flat
+        # payload, so a deployment can tighten phases below the
+        # whole-collective budget
+        from ..parallel import topology as _topology
+        self._groups = _topology.resolve_groups(
+            self._world, spec=cfg.get("rabit_hier_group"))
+        self._hier_scale = float(
+            cfg.get("rabit_hier_phase_deadline_scale", 1.0) or 1.0)
         self._debug = cfg.get_bool("rabit_debug")
         log.set_debug(self._debug)
         log.set_identity(self._rank, self._world)
@@ -165,6 +179,17 @@ class XlaEngine(Engine):
              [({}, self._watchdog.expired_total)]),
         ]
 
+    def _hier_phase_guard(self, name: str, nbytes: int):
+        """Per-phase watchdog deadline for the hierarchical schedule:
+        the usual payload-proportional deadline, scaled by
+        ``rabit_hier_phase_deadline_scale`` (phases move a fraction of
+        the flat payload, so <1 tightens them; disabled watchdog still
+        yields the shared no-op guard)."""
+        from ..utils.watchdog import scale_deadline_s
+        d = scale_deadline_s(nbytes, self._watchdog.floor_ms,
+                             self._watchdog.ms_per_mb) * self._hier_scale
+        return self._watchdog.guard(name, nbytes=nbytes, deadline_s=d)
+
     def shutdown(self) -> None:
         if self._metrics_server is not None:
             self._metrics_server.stop()
@@ -218,14 +243,91 @@ class XlaEngine(Engine):
             local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
             xs = jax.make_array_from_single_device_arrays(
                 (self._world, n), sharding, [local])
-            out = device_allreduce(xs, mesh, op, axis="proc",
-                                   method=method, wire=wire)
+            if method == "hier":
+                # phase-decomposed composition: reduce-scatter /
+                # inter-host / allgather run as separate programs so the
+                # watchdog polices each phase at its own (scaled) budget
+                from ..parallel.collectives import device_hier_allreduce
+                out = device_hier_allreduce(
+                    xs, mesh, op, axis="proc", groups=self._groups,
+                    wire=wire, phase_guard=self._hier_phase_guard)
+            else:
+                out = device_allreduce(xs, mesh, op, axis="proc",
+                                       method=method, wire=wire)
             res = np.asarray(out.addressable_data(0)).reshape(-1)
         if res.dtype != buf.dtype:
             raise TypeError(
                 f"device allreduce changed dtype {buf.dtype} -> {res.dtype}")
         np.copyto(buf, res)
         log_debug("xla allreduce n=%d op=%d method=%s", n, op, method)
+
+    def reduce_scatter(self, buf: np.ndarray, op: int) -> np.ndarray:
+        """True ring reduce-scatter on the device mesh: ships 1/p of
+        the allreduce bytes and returns only this rank's chunk (base.py
+        documents the ownership layout)."""
+        if self._world == 1:
+            return buf.copy()
+        if buf.size % self._world:
+            raise ValueError(
+                f"reduce_scatter payload of {buf.size} elements must "
+                f"divide by the world size {self._world}")
+        from ..parallel.collectives import device_reduce_scatter
+        from ..ops.reducers import OP_NAMES
+        with telemetry.span("engine.reduce_scatter", nbytes=buf.nbytes,
+                            op=OP_NAMES.get(op, str(op)), method="ring",
+                            round=telemetry.collective_round(
+                                "engine.reduce_scatter")), \
+                self._watchdog.guard("engine.reduce_scatter",
+                                     nbytes=buf.nbytes):
+            out = self._device_collective(
+                buf, lambda xs, mesh: device_reduce_scatter(
+                    xs, mesh, op, axis="proc"))
+        return out
+
+    def allgather(self, buf: np.ndarray) -> np.ndarray:
+        """True ring all-gather on the device mesh (no reduction
+        arithmetic, p-1 neighbor hops)."""
+        if self._world == 1:
+            return buf.reshape(-1).copy()
+        from ..parallel.collectives import device_allgather
+        nbytes = buf.nbytes * self._world
+        with telemetry.span("engine.allgather", nbytes=nbytes,
+                            method="ring",
+                            round=telemetry.collective_round(
+                                "engine.allgather")), \
+                self._watchdog.guard("engine.allgather", nbytes=nbytes):
+            out = self._device_collective(
+                buf, lambda xs, mesh: device_allgather(
+                    xs, mesh, axis="proc"))
+        return out
+
+    def _device_collective(self, buf: np.ndarray, fn) -> np.ndarray:
+        """Stage a host buffer as one row of the [world, n] mesh array,
+        run ``fn(xs, mesh)``, and fetch this rank's addressable shard
+        (the same staging as :meth:`allreduce`, including the x64
+        scope-enable for 8-byte dtypes)."""
+        import contextlib
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if buf.dtype.itemsize == 8:
+            ctx = (jax.enable_x64(True) if hasattr(jax, "enable_x64")
+                   else _experimental_enable_x64())
+        else:
+            ctx = contextlib.nullcontext()
+        mesh = self._mesh
+        n = buf.size
+        with ctx:
+            sharding = NamedSharding(mesh, P("proc"))
+            local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
+            xs = jax.make_array_from_single_device_arrays(
+                (self._world, n), sharding, [local])
+            out = fn(xs, mesh)
+            res = np.asarray(out.addressable_data(0)).reshape(-1)
+        if res.dtype != buf.dtype:
+            raise TypeError(
+                f"device collective changed dtype {buf.dtype} -> "
+                f"{res.dtype}")
+        return res
 
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:
         if self._world == 1:

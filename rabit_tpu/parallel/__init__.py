@@ -13,9 +13,10 @@ as ring attention.
 from .mesh import make_mesh, best_mesh_axis  # noqa: F401
 from .collectives import (  # noqa: F401
     ring_reduce_scatter, ring_all_gather, ring_allreduce,
-    bidir_ring_allreduce, swing_allreduce,
+    bidir_ring_allreduce, swing_allreduce, hier_allreduce,
     tree_allreduce, bcast_from_root,
     device_allreduce, device_broadcast,
+    device_reduce_scatter, device_allgather, device_hier_allreduce,
     bucket_allreduce, device_allreduce_tree,
     RING_MINCOUNT_DEFAULT, WIRE_MINCOUNT_DEFAULT,
     psum_identity_grad, ident_psum_grad,
@@ -24,6 +25,10 @@ from .collectives import (  # noqa: F401
 from .dispatch import (  # noqa: F401
     load_table as load_dispatch_table, resolve as resolve_dispatch,
     wire_mincount,
+)
+from .topology import (  # noqa: F401
+    resolve_groups, parse_groups, groups_spec, is_hierarchical,
+    delegates, slot_rings,
 )
 from .ring_attention import (  # noqa: F401
     ring_attention, ulysses_attention, sequence_parallel_attention,

@@ -20,6 +20,19 @@ re-designed for XLA/ICI:
   (arXiv:2401.09356) — log2(p) steps whose hop distances follow
   1,1,3,5,11,… so consecutive steps never reuse a link direction;
   power-of-two worlds only (falls back to the ring otherwise).
+- ``hier_allreduce``: two-level topology-aware allreduce (ROADMAP open
+  item 4) — intra-host ring reduce-scatter, inter-host ring/swing
+  allreduce of the reduced shards across per-slot rings (the
+  host-delegate fabric, ``parallel/topology.py``), then intra-host
+  all-gather. Expressed as a composition of the grouped RS/AG
+  primitives (every ``ring_*``/``swing_*`` schedule takes
+  ``groups=`` and runs over disjoint sub-rings concurrently), so with
+  g ranks per host the slow inter-host links carry 2n(H-1)/(gH)
+  bytes instead of the flat ring's 2n(p-1)/p.
+- ``device_reduce_scatter`` / ``device_allgather``: the two halves as
+  first-class public collectives (arXiv:2112.01075 argues they are the
+  substrate redistribution workloads compose from), span-instrumented
+  and cost-stamped like ``device_allreduce``.
 - ``device_allreduce`` dispatches {tree, ring, bidir, swing} and the
   wire per payload size from the measured table in
   ``parallel/dispatch.py`` — the ``reduce_ring_mincount`` crossover the
@@ -37,6 +50,7 @@ call them inside ``shard_map`` (or any SPMD context with a named axis).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional
 
@@ -49,6 +63,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import telemetry
 from ..telemetry import profile as _profile
 from ..ops.reducers import SUM, MAX, MIN, BITOR, OP_NAMES, jax_reduce_fn
+from . import topology as _topology
 from .dispatch import (RING_MINCOUNT_DEFAULT,  # noqa: F401  (re-export)
                        WIRE_MINCOUNT_DEFAULT, resolve as _dispatch_resolve)
 
@@ -104,6 +119,42 @@ def _ring_perm(p: int, reverse: bool = False):
     return [(i, (i + 1) % p) for i in range(p)]
 
 
+def _group_tables(groups, p: int):
+    """Static tables for grouped (sub-ring) schedules: ``groups`` must
+    partition ``range(p)`` into equal-size rings (SPMD: every rank runs
+    the identical program, so every sub-ring must have the same length
+    and chunk shape). Returns ``(size, local_of)`` where ``size`` is the
+    common ring length and ``local_of[rank]`` is the rank's position
+    around its own ring."""
+    flat = [r for grp in groups for r in grp]
+    if sorted(flat) != list(range(p)):
+        raise ValueError(
+            f"groups {groups!r} must partition ranks 0..{p - 1}")
+    sizes = {len(grp) for grp in groups}
+    if len(sizes) != 1:
+        raise ValueError(
+            f"grouped schedules need uniform group sizes, got {groups!r} "
+            "(SPMD runs one program on every rank; ragged groups would "
+            "need per-rank chunk shapes)")
+    local_of = [0] * p
+    for grp in groups:
+        for j, r in enumerate(grp):
+            local_of[r] = j
+    return next(iter(sizes)), tuple(local_of)
+
+
+def _group_ring_perm(groups, reverse: bool = False):
+    """Union of next-neighbor permutations over every sub-ring — one
+    ppermute moves all groups' rings concurrently."""
+    perm = []
+    for grp in groups:
+        g = len(grp)
+        for j, r in enumerate(grp):
+            perm.append((r, grp[(j - 1) % g] if reverse else
+                         grp[(j + 1) % g]))
+    return perm
+
+
 # Wire-quantization modes for the ring collectives (EQuARX-style: the
 # accumulator stays full-precision on-device; only the ppermute'd bytes
 # are compressed — arXiv:2506.17615 does this inside XLA for TPU
@@ -152,7 +203,8 @@ def _wire_decode(enc, wire: str, shape):
 
 def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
                         wire: str | None = None,
-                        reverse: bool = False) -> jax.Array:
+                        reverse: bool = False,
+                        groups=None) -> jax.Array:
     """Ring reduce-scatter: every rank contributes ``x`` (length n,
     divisible by axis size p) and ends owning chunk ``rank`` (length n/p)
     fully reduced. p-1 ppermute steps, each moving n/p elements — the
@@ -164,31 +216,45 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
     ring) or "int8" (block-scaled, ~4x, SUM only).
 
     ``reverse`` runs the mirror schedule around the counter-rotating
-    ring; ownership still lands on chunk == rank."""
+    ring; ownership still lands on chunk == rank.
+
+    ``groups`` (a static tuple of equal-size rank tuples partitioning
+    the axis) runs the same schedule over every sub-ring concurrently:
+    each rank reduces only with its own group and ends owning chunk
+    ``local index`` of the g-way split, reduced over its group — the
+    intra-host phase of :func:`hier_allreduce`."""
     if x.ndim != 1:
         raise ValueError(
             f"ring_reduce_scatter takes a 1-D per-shard array, got "
             f"shape {x.shape}; flatten first")
     p = axis_size(axis_name)
-    if p == 1:
+    if groups is None:
+        size, pos = p, lax.axis_index(axis_name)
+        perm = _ring_perm(p, reverse)
+    else:
+        size, local_of = _group_tables(groups, p)
+        pos = jnp.asarray(local_of)[lax.axis_index(axis_name)]
+        perm = _group_ring_perm(groups, reverse)
+    if size == 1:
         return x
-    wire = _normalize_wire(wire, op, x.dtype, x.shape[0] // p)
+    wire = _normalize_wire(wire, op, x.dtype, x.shape[0] // size)
     combine = jax_reduce_fn(op)
-    idx = lax.axis_index(axis_name)
-    chunks = x.reshape(p, -1)
-    perm = _ring_perm(p, reverse)
+    idx = pos
+    chunks = x.reshape(size, -1)
     # Schedule: at step s, send chunk (idx-s-1) mod p (accumulated so
     # far), receive into chunk (idx-s-2) mod p; after p-1 steps rank i
     # owns chunk i. (Offset chosen so ownership lands on chunk==rank,
     # unlike the classic (i+1) mod p formulation.) The reverse ring
     # mirrors the offsets: send (idx+s+1), receive into (idx+s+2).
-    for step in range(p - 1):
+    # Grouped runs are identical with p -> group size and rank -> the
+    # rank's position around its own sub-ring.
+    for step in range(size - 1):
         if reverse:
-            send_i = (idx + step + 1) % p
-            recv_i = (idx + step + 2) % p
+            send_i = (idx + step + 1) % size
+            recv_i = (idx + step + 2) % size
         else:
-            send_i = (idx - step - 1) % p
-            recv_i = (idx - step - 2) % p
+            send_i = (idx - step - 1) % size
+            recv_i = (idx - step - 2) % size
         send = lax.dynamic_index_in_dim(chunks, send_i, 0, keepdims=False)
         if wire is None:
             got = lax.ppermute(send, axis_name, perm)
@@ -204,7 +270,8 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
 
 def ring_all_gather(x: jax.Array, axis_name: str,
                     wire: str | None = None,
-                    reverse: bool = False) -> jax.Array:
+                    reverse: bool = False,
+                    groups=None) -> jax.Array:
     """Ring all-gather: rank i contributes chunk ``x`` (length m) and all
     ranks end with the concatenation [p*m] in rank order
     (TryAllgatherRing, allreduce_base.cc:751-815).
@@ -218,25 +285,34 @@ def ring_all_gather(x: jax.Array, axis_name: str,
     different hop distances then disagree at the last bit.)
 
     ``reverse`` gathers around the counter-rotating ring (pairs with
-    ``ring_reduce_scatter(reverse=True)``); rank order is unchanged."""
+    ``ring_reduce_scatter(reverse=True)``); rank order is unchanged.
+
+    ``groups`` gathers over every sub-ring concurrently: each rank ends
+    with the concatenation of its OWN group's chunks in group order —
+    the intra-host phase of :func:`hier_allreduce`."""
     p = axis_size(axis_name)
-    if p == 1:
+    if groups is None:
+        size, idx = p, lax.axis_index(axis_name)
+        perm = _ring_perm(p, reverse)
+    else:
+        size, local_of = _group_tables(groups, p)
+        idx = jnp.asarray(local_of)[lax.axis_index(axis_name)]
+        perm = _group_ring_perm(groups, reverse)
+    if size == 1:
         return x
     wire = _normalize_wire(wire, SUM, x.dtype, x.shape[0])
-    idx = lax.axis_index(axis_name)
-    perm = _ring_perm(p, reverse)
     if wire is not None:
         enc = _wire_encode(x, wire)
         x = _wire_decode(enc, wire, x.shape).astype(x.dtype)
-    out = jnp.zeros((p,) + x.shape, x.dtype)
+    out = jnp.zeros((size,) + x.shape, x.dtype)
     out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
-    for step in range(p - 1):
+    for step in range(size - 1):
         if reverse:
-            send_i = (idx + step) % p
-            recv_i = (idx + step + 1) % p
+            send_i = (idx + step) % size
+            recv_i = (idx + step + 1) % size
         else:
-            send_i = (idx - step) % p
-            recv_i = (idx - step - 1) % p
+            send_i = (idx - step) % size
+            recv_i = (idx - step - 1) % size
         if wire is None:
             send = lax.dynamic_index_in_dim(out, send_i, 0,
                                             keepdims=False)
@@ -248,7 +324,7 @@ def ring_all_gather(x: jax.Array, axis_name: str,
             enc = tuple(lax.ppermute(e, axis_name, perm) for e in enc)
             got = _wire_decode(enc, wire, x.shape).astype(x.dtype)
         out = lax.dynamic_update_index_in_dim(out, got, recv_i, 0)
-    return out.reshape((p * x.shape[0],) + x.shape[1:])
+    return out.reshape((size * x.shape[0],) + x.shape[1:])
 
 
 def _pad_to_multiple(x: jax.Array, p: int):
@@ -261,7 +337,8 @@ def _pad_to_multiple(x: jax.Array, p: int):
 
 def ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
                    wire: str | None = None,
-                   reverse: bool = False) -> jax.Array:
+                   reverse: bool = False,
+                   groups=None) -> jax.Array:
     """Ring allreduce = reduce-scatter + all-gather (TryAllreduceRing,
     allreduce_base.cc:930-949). Handles lengths not divisible by p by
     zero-padding (zero is the identity for sum/bitor; for max/min the
@@ -271,24 +348,30 @@ def ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     ppermute'd bytes — EQuARX-style wire quantization with
     full-precision on-device accumulation. All ranks still end
     bit-identical (the all-gather rounds the owner's chunk through the
-    same encoding the hops use)."""
+    same encoding the hops use).
+
+    ``groups`` allreduces over every sub-ring concurrently (each rank's
+    result reduces only its own group's contributions) — the inter-host
+    phase of :func:`hier_allreduce` runs this over slot rings."""
     if x.ndim != 1:
         raise ValueError(
             f"ring_allreduce takes a 1-D per-shard array, got shape "
             f"{x.shape}; flatten first (the chunking math silently "
             "misreduces higher-rank inputs)")
     p = axis_size(axis_name)
-    if p == 1:
+    size = p if groups is None else _group_tables(groups, p)[0]
+    if size == 1:
         return x
     wire = _normalize_wire(wire, op, x.dtype)  # eligibility; pad below
     # int8 wants the per-rank chunk to tile into blocks; zero-padding is
     # the SUM identity and the tail is sliced off, so pad up rather than
     # silently degrading real-world sizes to bf16
-    mult = p * _INT8_BLOCK if wire == "int8" else p
+    mult = size * _INT8_BLOCK if wire == "int8" else size
     xp, n = _pad_to_multiple(x, mult)
     mine = ring_reduce_scatter(xp, axis_name, op, wire=wire,
-                               reverse=reverse)
-    full = ring_all_gather(mine, axis_name, wire=wire, reverse=reverse)
+                               reverse=reverse, groups=groups)
+    full = ring_all_gather(mine, axis_name, wire=wire, reverse=reverse,
+                           groups=groups)
     return full[:n]
 
 
@@ -368,7 +451,8 @@ def _swing_tables(p: int):
 
 
 def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
-                    wire: str | None = None) -> jax.Array:
+                    wire: str | None = None,
+                    groups=None) -> jax.Array:
     """Swing allreduce (arXiv:2401.09356): recursive distance-halving
     reduce-scatter + the mirrored all-gather, 2·log2(p) steps total
     against the ring's 2(p-1) — the latency sweet spot between the tree
@@ -380,24 +464,44 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     ``wire`` ("bf16" | "int8", float SUM only) compresses only the
     ppermute'd bytes, accumulation stays full-precision, and the
     all-gather forwards each chunk's encoding verbatim so all p ranks
-    end bit-identical."""
+    end bit-identical.
+
+    ``groups`` runs the schedule over every sub-ring concurrently
+    (power-of-two GROUP size required; otherwise the grouped ring
+    fallback) — the inter-host phase of
+    ``hier_allreduce(inter_method="swing")``."""
     if x.ndim != 1:
         raise ValueError(
             f"swing_allreduce takes a 1-D per-shard array, got shape "
             f"{x.shape}; flatten first")
     p = axis_size(axis_name)
-    if p == 1:
+    if groups is None:
+        size, local_of = p, None
+    else:
+        size, local_of = _group_tables(groups, p)
+    if size == 1:
         return x
-    if p & (p - 1) or x.shape[0] == 0:
-        return ring_allreduce(x, axis_name, op, wire=wire)
+    if size & (size - 1) or x.shape[0] == 0:
+        return ring_allreduce(x, axis_name, op, wire=wire, groups=groups)
     wire = _normalize_wire(wire, op, x.dtype)  # eligibility; pad below
-    mult = p * _INT8_BLOCK if wire == "int8" else p
+    mult = size * _INT8_BLOCK if wire == "int8" else size
     xp, n = _pad_to_multiple(x, mult)
-    peers, send_idx, recv_idx = _swing_tables(p)
+    peers, send_idx, recv_idx = _swing_tables(size)
     k = len(peers)
     combine = jax_reduce_fn(op)
     idx = lax.axis_index(axis_name)
-    chunks = xp.reshape(p, -1)
+    if groups is not None:
+        idx = jnp.asarray(local_of)[idx]
+
+    def _peer_perm(s):
+        # flat: rank i <-> peers[s][i]; grouped: the same involution
+        # inside every sub-ring at once, in local coordinates
+        if groups is None:
+            return [(i, peers[s][i]) for i in range(p)]
+        return [(grp[i], grp[peers[s][i]]) for grp in groups
+                for i in range(size)]
+
+    chunks = xp.reshape(size, -1)
     m = chunks.shape[1]
 
     # Reduce-scatter: at step s exchange with peers[s], shipping the
@@ -406,7 +510,7 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     # peer ships its rows sorted by chunk index — the same order as our
     # recv_idx rows — so received rows align without a permutation.
     for s in range(k):
-        perm = [(i, peers[s][i]) for i in range(p)]
+        perm = _peer_perm(s)
         send_rows = jnp.asarray(send_idx[s])[idx]
         recv_rows = jnp.asarray(recv_idx[s])[idx]
         send = jnp.take(chunks, send_rows, axis=0)
@@ -427,10 +531,10 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     # ring_all_gather on why re-encoding per hop breaks the
     # bit-identical-ranks replay contract).
     if wire is None:
-        out = jnp.zeros((p, m), mine.dtype)
+        out = jnp.zeros((size, m), mine.dtype)
         out = lax.dynamic_update_index_in_dim(out, mine, idx, 0)
         for s in range(k - 1, -1, -1):
-            perm = [(i, peers[s][i]) for i in range(p)]
+            perm = _peer_perm(s)
             send_rows = jnp.asarray(recv_idx[s])[idx]
             recv_rows = jnp.asarray(send_idx[s])[idx]
             send = jnp.take(out, send_rows, axis=0)
@@ -440,10 +544,10 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
         enc0 = _wire_encode(mine, wire)
         store = tuple(
             lax.dynamic_update_index_in_dim(
-                jnp.zeros((p,) + e.shape, e.dtype), e, idx, 0)
+                jnp.zeros((size,) + e.shape, e.dtype), e, idx, 0)
             for e in enc0)
         for s in range(k - 1, -1, -1):
-            perm = [(i, peers[s][i]) for i in range(p)]
+            perm = _peer_perm(s)
             send_rows = jnp.asarray(recv_idx[s])[idx]
             recv_rows = jnp.asarray(send_idx[s])[idx]
             got = tuple(
@@ -456,8 +560,108 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
         else:
             q, scale = store
             out = q.astype(jnp.float32) * scale
-        out = out.reshape(p, m).astype(mine.dtype)
-    return out.reshape(p * m)[:n]
+        out = out.reshape(size, m).astype(mine.dtype)
+    return out.reshape(size * m)[:n]
+
+
+def _intra_axis_groups(groups):
+    return [list(grp) for grp in groups]
+
+
+def _intra_reduce_scatter(x: jax.Array, axis_name: str, op: int,
+                          groups) -> jax.Array:
+    """Intra-host reduce-scatter phase. The local links are the fast
+    fabric (shared memory in-process, ICI on a slice), so SUM rides
+    XLA's native grouped ReduceScatter HLO — measured ~3-4x the manual
+    ppermute ring on the CPU backend — with ownership landing on the
+    local index, the same layout as the grouped manual ring. Ops with
+    no native scatter variant (MAX/MIN/BITOR) run the manual grouped
+    ring instead."""
+    if op == SUM:
+        return lax.psum_scatter(
+            x, axis_name, scatter_dimension=0, tiled=True,
+            axis_index_groups=_intra_axis_groups(groups))
+    return ring_reduce_scatter(x, axis_name, op, groups=groups)
+
+
+def _intra_all_gather(x: jax.Array, axis_name: str, groups) -> jax.Array:
+    """Intra-host all-gather phase via the native grouped AllGather HLO;
+    the group-order concatenation matches ``ring_all_gather(groups=)``."""
+    return lax.all_gather(
+        x, axis_name, axis=0, tiled=True,
+        axis_index_groups=_intra_axis_groups(groups))
+
+
+def hier_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
+                   groups=None, wire: str | None = None,
+                   inter_method: str = "ring") -> jax.Array:
+    """Two-level hierarchical allreduce over host groups (ROADMAP open
+    item 4), expressed as a composition of the grouped primitives:
+
+    1. intra-host reduce-scatter over each group (cheap UDS/ICI
+       links — XLA's native grouped collective where the op allows,
+       :func:`_intra_reduce_scatter`; never wire-quantized —
+       quantization buys nothing where bandwidth is free);
+    2. inter-host allreduce of the reduced shards over the slot rings
+       (rank j of every host forms ring j — the host-delegate fabric;
+       this is the only phase crossing the slow links, so ``wire``
+       applies here);
+    3. intra-host ring all-gather redistributing the finished shards.
+
+    With p ranks on H hosts (g = p/H per host), the slow links carry
+    2n(H-1)/(gH) bytes per rank instead of the flat ring's 2n(p-1)/p —
+    a ~g-fold reduction — in 2(g-1) + 2(H-1) ppermute steps instead of
+    2(p-1).
+
+    Degenerate worlds short-circuit instead of running empty phases:
+    unknown topology (``groups=None``) and one-rank-per-host run the
+    flat ``inter_method`` schedule (every link is inter-host); a single
+    group runs one flat unquantized ring (every link is intra-host);
+    ragged groups fall back to the flat schedule (SPMD needs uniform
+    chunk shapes). All p ranks end bit-identical — each global chunk's
+    bits are produced once, by its slot ring, and phase 3 only copies
+    them (the replay/recovery contract; note hier and flat ring SUM
+    results may differ from each other by float association)."""
+    if x.ndim != 1:
+        raise ValueError(
+            f"hier_allreduce takes a 1-D per-shard array, got shape "
+            f"{x.shape}; flatten first")
+    if inter_method not in ("ring", "swing"):
+        raise ValueError(
+            f"inter_method must be 'ring' or 'swing', got {inter_method!r}")
+    p = axis_size(axis_name)
+    if p == 1:
+        return x
+    flat_fn = swing_allreduce if inter_method == "swing" else ring_allreduce
+    if not groups or not _topology.is_hierarchical(groups, p):
+        if groups and len(groups) == 1:
+            # all ranks share one host: pure intra-host path, and local
+            # links never pay for a lossy wire
+            return ring_allreduce(x, axis_name, op, wire=None)
+        # unknown topology, one rank per host, or ragged groups: the
+        # flat schedule IS the inter-host path
+        return flat_fn(x, axis_name, op, wire=wire)
+    groups = tuple(tuple(int(r) for r in grp) for grp in groups)
+    g, _ = _group_tables(groups, p)
+    slots = _topology.slot_rings(groups)
+    wire = _normalize_wire(wire, op, x.dtype)  # eligibility; pad below
+    # pad so the intra shard (n/g) splits evenly into inter chunks
+    # (n/p); the int8 block constraint lands on the inter phase's
+    # per-rank chunk
+    mult = p * _INT8_BLOCK if wire == "int8" else p
+    xp, n = _pad_to_multiple(x, mult)
+    with telemetry.trace_annotation("rabit_hier_reduce_scatter"):
+        mine = _intra_reduce_scatter(xp, axis_name, op, groups)
+    with telemetry.trace_annotation("rabit_hier_inter"):
+        if inter_method == "swing":
+            mine = swing_allreduce(mine, axis_name, op, wire=wire,
+                                   groups=slots)
+        else:
+            mine = ring_allreduce(mine, axis_name, op, wire=wire,
+                                  groups=slots)
+    with telemetry.trace_annotation("rabit_hier_allgather"):
+        full = _intra_all_gather(mine, axis_name, groups)
+    return full[:n]
 
 
 def tree_allreduce(x: jax.Array, axis_name: str, op: int = SUM) -> jax.Array:
@@ -556,7 +760,7 @@ _METHOD_FNS = {
 
 
 def _per_shard_allreduce(flat, axis: str, op: int, method: str,
-                         wire: str | None):
+                         wire: str | None, groups=None):
     # named_scope (metadata-only, zero jaxpr equations either way) makes
     # the chosen schedule attributable in XLA profiles when telemetry is
     # on; nullcontext when off
@@ -564,18 +768,20 @@ def _per_shard_allreduce(flat, axis: str, op: int, method: str,
     with telemetry.trace_annotation(label):
         if method == "tree":
             return tree_allreduce(flat, axis, op)
+        if method == "hier":
+            return hier_allreduce(flat, axis, op, groups=groups, wire=wire)
         return _METHOD_FNS[method](flat, axis, op, wire=wire)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "method",
-                                             "wire"))
+                                             "wire", "groups"))
 def _allreduce_global(xs, mesh: Mesh, axis: str, op: int, method: str,
-                      wire: str | None = None):
+                      wire: str | None = None, groups=None):
     def per_shard(x):
         x = x.reshape(x.shape[1:])  # drop the per-device leading 1
         flat = x.reshape(-1)
-        return _per_shard_allreduce(flat, axis, op, method, wire).reshape(
-            x.shape)
+        return _per_shard_allreduce(flat, axis, op, method, wire,
+                                    groups).reshape(x.shape)
     # ring-family bodies are ppermute chains — and the BitOR tree body
     # is an all_gather + local fold — whose replicated outputs the
     # static checker cannot infer; the psum/pmax/pmin tree path is
@@ -589,7 +795,8 @@ def _allreduce_global(xs, mesh: Mesh, axis: str, op: int, method: str,
 def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
                      axis: Optional[str] = None,
                      method: str = "auto",
-                     wire: Optional[str] = "auto") -> jax.Array:
+                     wire: Optional[str] = "auto",
+                     groups=None) -> jax.Array:
     """Allreduce across a mesh axis. ``xs`` has shape [p, ...] with the
     leading axis sharded over ``axis``; returns the elementwise reduction
     with shape ``xs.shape[1:]``, replicated.
@@ -608,28 +815,248 @@ def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
     (``rabit_dataplane_wire``) only at payload sizes where measurement
     says it pays (the table's wire column, else
     ``rabit_dataplane_wire_mincount``).
+
+    ``groups``: host grouping for the hierarchical schedule — explicit
+    tuple-of-tuples, else resolved from the ``rabit_hier_group``
+    override / tracker-discovered ``RABIT_HIER_GROUP`` env
+    (``parallel/topology.py``). ``method="auto"`` picks ``hier`` when
+    the table says hierarchy wins at this size AND the grouping is
+    genuinely two-level; ``method="hier"`` on a degenerate world runs
+    the matching flat schedule.
     """
     if axis is None:
         axis = mesh.axis_names[0]
     n = int(np.prod(xs.shape[1:]))
+    groups = _topology.resolve_groups(mesh.shape[axis], explicit=groups)
     method, wire = _dispatch_resolve(n, xs.dtype, op, mesh.shape[axis],
-                                     method=method, wire=wire)
-    cost = _profile.record_cost("allreduce", method, wire, n,
-                                xs.dtype.itemsize, mesh.shape[axis])
+                                     method=method, wire=wire,
+                                     groups=groups)
+    if method != "hier":
+        groups = None  # flat schedules ignore topology: keep the jit
+        #                cache key stable across grouping changes
+    cost = _profile.record_cost(
+        "allreduce", method, wire, n, xs.dtype.itemsize, mesh.shape[axis],
+        group_size=len(groups[0]) if groups else None)
     extra = ({"cost_flops": cost["flops"],
               "cost_wire_bytes": cost["wire_bytes"],
               "cost_hops": cost["hops"]} if cost else {})
+    if groups:
+        extra["hosts"] = len(groups)
     sp = telemetry.span("allreduce", nbytes=n * xs.dtype.itemsize,
                         op=OP_NAMES.get(op, str(op)), method=method,
                         wire=wire, **extra)
     with sp:
         with _profile.jit_probe("allreduce", _allreduce_global):
-            out = _allreduce_global(xs, mesh, axis, op, method, wire)
+            out = _allreduce_global(xs, mesh, axis, op, method, wire,
+                                    groups)
         if sp.live:
             # only when measuring: a span closed on dispatch would time
             # the async enqueue, not the collective
             out.block_until_ready()
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "wire"))
+def _reduce_scatter_global(xs, mesh: Mesh, axis: str, op: int,
+                           wire: str | None = None):
+    def per_shard(x):
+        flat = x.reshape(-1)  # drop the per-device leading 1
+        with telemetry.trace_annotation("rabit_reduce_scatter"):
+            return ring_reduce_scatter(flat, axis, op, wire=wire)
+    return unchecked_shard_map(per_shard, mesh=mesh, in_specs=P(axis),
+                               out_specs=P(axis))(xs)
+
+
+def device_reduce_scatter(xs: jax.Array, mesh: Mesh, op: int = SUM,
+                          axis: Optional[str] = None,
+                          wire: Optional[str] = None) -> jax.Array:
+    """Reduce-scatter across a mesh axis, as a first-class collective
+    (arXiv:2112.01075 makes the case that RS/AG are the substrate
+    redistribution composes from). ``xs`` has shape [p, ...] with the
+    leading axis sharded over ``axis``; returns a length-n 1-D array
+    (n = prod(xs.shape[1:])) sharded over ``axis`` whose i-th shard —
+    n/p elements starting at i*n/p — is chunk i of the elementwise
+    reduction: rank i owns chunk i, the reference's ownership
+    convention (allreduce_base.cc:829-918) and the layout
+    :func:`device_allgather` inverts.
+
+    n must divide by p: a composable primitive must not pad silently,
+    the caller owns the chunk layout (:func:`device_allreduce` is the
+    pad-and-slice convenience). ``wire`` compresses the shipped bytes
+    as in :func:`ring_reduce_scatter` (float SUM only)."""
+    if axis is None:
+        axis = mesh.axis_names[0]
+    p = mesh.shape[axis]
+    n = int(np.prod(xs.shape[1:]))
+    if n % p:
+        raise ValueError(
+            f"reduce_scatter payload of {n} elements must divide by the "
+            f"axis size {p} (rank i owns chunk i of length n/p); pad the "
+            "input or use device_allreduce")
+    wire = None if wire in (None, "none", "auto") else wire
+    wire = _normalize_wire(wire, op, xs.dtype, n // p)
+    cost = _profile.record_cost("reduce_scatter", "ring", wire, n,
+                                xs.dtype.itemsize, p, phase="rs")
+    extra = ({"cost_flops": cost["flops"],
+              "cost_wire_bytes": cost["wire_bytes"],
+              "cost_hops": cost["hops"]} if cost else {})
+    sp = telemetry.span("reduce_scatter", nbytes=n * xs.dtype.itemsize,
+                        op=OP_NAMES.get(op, str(op)), method="ring",
+                        wire=wire, **extra)
+    with sp:
+        with _profile.jit_probe("reduce_scatter", _reduce_scatter_global):
+            out = _reduce_scatter_global(xs, mesh, axis, op, wire)
+        if sp.live:
+            out.block_until_ready()
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _allgather_global(xs, mesh: Mesh, axis: str):
+    def per_shard(x):
+        flat = x.reshape(-1)  # drop the per-device leading 1
+        with telemetry.trace_annotation("rabit_allgather"):
+            return ring_all_gather(flat, axis)
+    return unchecked_shard_map(per_shard, mesh=mesh, in_specs=P(axis),
+                               out_specs=P())(xs)
+
+
+def device_allgather(xs: jax.Array, mesh: Mesh,
+                     axis: Optional[str] = None) -> jax.Array:
+    """All-gather across a mesh axis, as a first-class collective: rank
+    i contributes its slice ``xs[i]`` (m elements, flattened) and every
+    rank ends with the length p*m rank-order concatenation, replicated
+    (TryAllgatherRing, allreduce_base.cc:751-815). The inverse of
+    :func:`device_reduce_scatter`'s ownership layout; hierarchical
+    allreduce is literally RS + inter-host reduction + this."""
+    if axis is None:
+        axis = mesh.axis_names[0]
+    p = mesh.shape[axis]
+    m = int(np.prod(xs.shape[1:]))
+    n = p * m
+    cost = _profile.record_cost("allgather", "ring", None, n,
+                                xs.dtype.itemsize, p, phase="ag")
+    extra = ({"cost_flops": cost["flops"],
+              "cost_wire_bytes": cost["wire_bytes"],
+              "cost_hops": cost["hops"]} if cost else {})
+    sp = telemetry.span("allgather", nbytes=n * xs.dtype.itemsize,
+                        method="ring", **extra)
+    with sp:
+        with _profile.jit_probe("allgather", _allgather_global):
+            out = _allgather_global(xs, mesh, axis)
+        if sp.live:
+            out.block_until_ready()
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "groups",
+                                             "mult"))
+def _hier_rs_global(xs, mesh: Mesh, axis: str, op: int, groups, mult: int):
+    def per_shard(x):
+        flat = x.reshape(-1)
+        xp, _ = _pad_to_multiple(flat, mult)
+        with telemetry.trace_annotation("rabit_hier_reduce_scatter"):
+            return _intra_reduce_scatter(xp, axis, op, groups)[None, :]
+    return unchecked_shard_map(per_shard, mesh=mesh, in_specs=P(axis),
+                               out_specs=P(axis))(xs)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "slots",
+                                             "wire", "inter_method"))
+def _hier_inter_global(xs, mesh: Mesh, axis: str, op: int, slots,
+                       wire: str | None, inter_method: str):
+    fn = swing_allreduce if inter_method == "swing" else ring_allreduce
+    def per_shard(x):
+        flat = x.reshape(-1)
+        with telemetry.trace_annotation("rabit_hier_inter"):
+            return fn(flat, axis, op, wire=wire, groups=slots)[None, :]
+    return unchecked_shard_map(per_shard, mesh=mesh, in_specs=P(axis),
+                               out_specs=P(axis))(xs)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "groups"))
+def _hier_ag_global(xs, mesh: Mesh, axis: str, groups):
+    def per_shard(x):
+        flat = x.reshape(-1)
+        with telemetry.trace_annotation("rabit_hier_allgather"):
+            return _intra_all_gather(flat, axis, groups)
+    return unchecked_shard_map(per_shard, mesh=mesh, in_specs=P(axis),
+                               out_specs=P())(xs)
+
+
+def device_hier_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
+                          axis: Optional[str] = None,
+                          groups=None, wire: Optional[str] = None,
+                          inter_method: str = "ring",
+                          phase_guard=None) -> jax.Array:
+    """Phase-decomposed hierarchical allreduce: the same math as
+    ``device_allreduce(method="hier")`` but dispatched as THREE device
+    programs so the host observes the phase boundaries — each phase
+    gets its own telemetry span (shared ``round`` id, ``phase`` attr,
+    so cross-rank stitching attributes stragglers to a phase) and, via
+    ``phase_guard``, its own watchdog deadline. The engines run this
+    variant for ``rabit_reduce_method=hier``; the fused single-program
+    path stays the ``device_allreduce`` fast path.
+
+    ``phase_guard(phase_name, nbytes)`` must return a context manager
+    (the engines pass a watchdog-guard factory scaled by
+    ``rabit_hier_phase_deadline_scale``; default no-op). Degenerate
+    topologies short-circuit to one flat program, same rules as
+    :func:`hier_allreduce`."""
+    if axis is None:
+        axis = mesh.axis_names[0]
+    p = mesh.shape[axis]
+    groups = _topology.resolve_groups(p, explicit=groups)
+    if not _topology.is_hierarchical(groups, p):
+        if groups and len(groups) == 1:
+            wire = None  # single host: every link is local
+        flat = "swing" if inter_method == "swing" else "ring"
+        return device_allreduce(xs, mesh, op=op, axis=axis, method=flat,
+                                wire=wire or "none")
+    g, hosts = len(groups[0]), len(groups)
+    slots = _topology.slot_rings(groups)
+    shape = xs.shape[1:]
+    n = int(np.prod(shape))
+    itemsize = xs.dtype.itemsize
+    wire = None if wire in (None, "none", "auto") else wire
+    wire = _normalize_wire(wire, op, xs.dtype)
+    mult = p * _INT8_BLOCK if wire == "int8" else p
+    n_pad = n + (-n) % mult
+    rnd = telemetry.collective_round("hier_allreduce")
+    opname = OP_NAMES.get(op, str(op))
+    guard = phase_guard or (lambda name, nbytes: contextlib.nullcontext())
+
+    def _phase(name, phase, nbytes, method, w, cost_n, cost_axis,
+               cost_phase, fn, *args):
+        cost = _profile.record_cost(name, method, w, cost_n, itemsize,
+                                    cost_axis, phase=cost_phase,
+                                    group_size=g)
+        extra = ({"cost_flops": cost["flops"],
+                  "cost_wire_bytes": cost["wire_bytes"],
+                  "cost_hops": cost["hops"]} if cost else {})
+        sp = telemetry.span(name, nbytes=nbytes, op=opname, method=method,
+                            wire=w, round=rnd, phase=phase, hosts=hosts,
+                            group_size=g, **extra)
+        with guard(name, nbytes):
+            with sp:
+                with _profile.jit_probe(name, fn):
+                    out = fn(*args)
+                if sp.live:
+                    out.block_until_ready()
+        return out
+
+    mid = _phase("hier.reduce_scatter", "reduce_scatter",
+                 n * itemsize, "ring", None, n, g, "rs",
+                 _hier_rs_global, xs, mesh, axis, op, groups, mult)
+    mid = _phase("hier.inter", "inter",
+                 (n_pad // g) * itemsize, inter_method, wire,
+                 n_pad // g, hosts, None,
+                 _hier_inter_global, mid, mesh, axis, op, slots, wire,
+                 inter_method)
+    out = _phase("hier.allgather", "allgather",
+                 n * itemsize, "ring", None, n_pad, g, "ag",
+                 _hier_ag_global, mid, mesh, axis, groups)
+    return out[:n].reshape(shape)
 
 
 def bucket_allreduce(tree, axis_name: str, op: int = SUM,

@@ -36,7 +36,7 @@ RING_MINCOUNT_DEFAULT = 32 << 10
 # 256K elements sits conservatively inside that band.
 WIRE_MINCOUNT_DEFAULT = 256 << 10
 
-METHODS = ("tree", "ring", "bidir", "swing")
+METHODS = ("tree", "ring", "bidir", "swing", "hier")
 
 SCHEMA_PREFIX = "rabit_tpu.collective_sweep/"
 SCHEMA = SCHEMA_PREFIX + "v1"
@@ -85,6 +85,10 @@ def _valid_rows(rows) -> bool:
         if not (r.get("max_n") is None or isinstance(r["max_n"], int)):
             return False
         if r.get("wire") not in (None, "bf16", "int8"):
+            return False
+        # "flat": the schedule a hier row degrades to on worlds without
+        # a usable host grouping (optional; hier rows only)
+        if r.get("flat") not in (None, "tree", "ring", "bidir", "swing"):
             return False
     return rows[-1].get("max_n") is None  # must cover every size
 
@@ -151,12 +155,21 @@ def _bucket(rows, n: int) -> dict:
 
 def resolve(n: int, dtype, op: int, axis_size: int,
             method: str = "auto",
-            wire: Optional[str] = "auto") -> Tuple[str, Optional[str]]:
+            wire: Optional[str] = "auto",
+            groups=None) -> Tuple[str, Optional[str]]:
     """Resolve ``(method, wire)`` for an ``n``-element payload.
 
     ``method="auto"``: per-size-bucket choice from the committed table,
     else tree below ``RING_MINCOUNT_DEFAULT`` and ring above (with the
     big-BitOR override — the tree BitOR path all-gathers).
+
+    ``groups`` is the resolved host grouping (``parallel/topology.py``).
+    A table row saying ``hier`` only engages when the grouping is
+    genuinely two-level (>1 host, >1 rank/host, uniform); otherwise the
+    row's ``flat`` column (else the fallback constants) applies — auto
+    never runs a hierarchical schedule on a world with no hierarchy.
+    An EXPLICIT ``method="hier"`` on such a world degrades to ``ring``,
+    the same degradation contract as swing on a non-power-of-two world.
 
     ``wire="auto"``: engages the ``RABIT_DATAPLANE_WIRE`` env wire (the
     ``rabit_dataplane_wire`` config export) only where measurement says
@@ -172,14 +185,21 @@ def resolve(n: int, dtype, op: int, axis_size: int,
     import jax.numpy as jnp
 
     from ..ops.reducers import BITOR, SUM, OP_NAMES
+    from . import topology
     requested = method
     table = load_table()
     wire_eligible = op == SUM and jnp.issubdtype(jnp.dtype(dtype),
                                                  jnp.floating)
+    hier_ok = (topology.hier_enabled()
+               and topology.is_hierarchical(groups, axis_size))
     if method == "auto":
         if table is not None:
             rows = table["float_sum"] if wire_eligible else table["other"]
-            method = _bucket(rows, n)["method"]
+            row = _bucket(rows, n)
+            method = row["method"]
+            if method == "hier" and not hier_ok:
+                method = row.get("flat") or (
+                    "ring" if n >= RING_MINCOUNT_DEFAULT else "tree")
         else:
             method = "ring" if n >= RING_MINCOUNT_DEFAULT else "tree"
         if op == BITOR and n >= 1024 and method == "tree":
@@ -187,6 +207,9 @@ def resolve(n: int, dtype, op: int, axis_size: int,
     if method not in METHODS:
         raise ValueError(f"method must be one of {('auto',) + METHODS}, "
                          f"got {method!r}")
+    if method == "hier" and not hier_ok:
+        method = "ring"  # no usable host grouping: flat ring IS the
+        #                  inter-host path (degradation contract)
     if method == "swing" and axis_size & (axis_size - 1):
         method = "ring"  # swing needs a power-of-two world
     if wire == "auto":

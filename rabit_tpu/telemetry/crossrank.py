@@ -26,19 +26,26 @@ from typing import Dict, Iterable, List, Optional
 
 from .schema import matches
 
-# Span names that represent one cross-rank collective occurrence.
+# Span names that represent one cross-rank collective occurrence. The
+# hier.* names are the three phases of one hierarchical allreduce —
+# they share a round id, so each phase stitches into its own row and
+# the report shows WHICH phase a straggler lost time in.
 ROUND_SPAN_NAMES = ("engine.allreduce", "engine.broadcast",
-                    "dataplane.allreduce")
+                    "engine.reduce_scatter", "engine.allgather",
+                    "dataplane.allreduce", "hier.reduce_scatter",
+                    "hier.inter", "hier.allgather")
 
 
 def _records_from_spans(spans: Iterable[dict],
                         t_base_unix: float) -> List[dict]:
     out = []
     for s in spans:
-        rnd = (s.get("attrs") or {}).get("round")
+        attrs = s.get("attrs") or {}
+        rnd = attrs.get("round")
         if rnd is None:
             continue
         out.append({"round": int(rnd), "name": s["name"],
+                    "phase": attrs.get("phase"),
                     "t_wall": t_base_unix + float(s.get("t0", 0.0)),
                     "dur": float(s.get("dur", 0.0))})
     return out
@@ -50,10 +57,12 @@ def _records_from_trace(doc: dict) -> List[dict]:
     for ev in doc.get("traceEvents", []):
         if ev.get("ph") != "X":
             continue
-        rnd = (ev.get("args") or {}).get("round")
+        args = ev.get("args") or {}
+        rnd = args.get("round")
         if rnd is None:
             continue
         out.append({"round": int(rnd), "name": ev["name"],
+                    "phase": args.get("phase"),
                     "t_wall": base + float(ev.get("ts", 0.0)) / 1e6,
                     "dur": float(ev.get("dur", 0.0)) / 1e6})
     return out
@@ -91,7 +100,10 @@ def stitch_rounds(per_rank: Dict[int, List[dict]]) -> List[dict]:
             key = (r["name"], r["round"])
             row = rounds.setdefault(key, {"name": r["name"],
                                           "round": r["round"],
+                                          "phase": r.get("phase"),
                                           "arrivals": {}, "durs": {}})
+            if row.get("phase") is None:
+                row["phase"] = r.get("phase")
             row["arrivals"][rank] = r["t_wall"]
             row["durs"][rank] = r["dur"]
     out = []

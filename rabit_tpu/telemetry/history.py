@@ -112,6 +112,17 @@ def extract_metrics(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     for key in ("best_step_s", "compile_plus_first_step_s"):
         if key in doc:
             add(key, doc.get(key), "s")
+    if doc.get("schema") == "rabit_tpu.collective_sweep/v1" \
+            and not doc.get("smoke"):  # smoke timings are noise by design
+        # one series per (section, method, wire, size): the sentinel
+        # then trends every schedule's s_per_op across committed sweeps
+        # — a slowed-down hier inter phase fails CI like any perf bug
+        for r in doc.get("rows", []):
+            if not isinstance(r, dict):
+                continue
+            wire = f"_{r['wire']}" if r.get("wire") else ""
+            add(f"sweep_s_per_op.{r.get('section')}.{r.get('method')}"
+                f"{wire}.n_{r.get('n')}", r.get("s_per_op"), "s")
     return out
 
 

@@ -62,8 +62,9 @@ def _env_enabled() -> bool:
 
 
 def collective_cost(method: Optional[str], n: int, itemsize: int,
-                    axis_size: int, wire: Optional[str] = None
-                    ) -> Dict[str, Any]:
+                    axis_size: int, wire: Optional[str] = None,
+                    phase: Optional[str] = None,
+                    group_size: Optional[int] = None) -> Dict[str, Any]:
     """Analytic per-rank cost of one allreduce-shaped collective.
 
     Returns ``{"flops", "wire_bytes", "hops"}``. All bandwidth-optimal
@@ -71,21 +72,43 @@ def collective_cost(method: Optional[str], n: int, itemsize: int,
     per rank; they differ in hop count (latency term). Tree/psum is
     modelled the same way over ``2·ceil(log2 p)`` hops — an upper-bound
     fiction for XLA's fused psum, but a stable one to trend against.
+
+    ``phase="rs"`` / ``"ag"`` models a standalone reduce-scatter /
+    all-gather: one direction of the round trip (``n·(p−1)/p`` elements,
+    ``p−1`` ring hops; an all-gather reduces nothing, so flops 0).
+
+    ``method="hier"`` with ``group_size=g`` models the two-level
+    schedule on H = p/g hosts: intra RS + AG at full precision plus an
+    inter allreduce of n/g elements over H ranks (the only wire-scaled
+    term), in ``2(g−1) + 2(H−1)`` hops.
     """
     p = max(1, int(axis_size))
     n = max(0, int(n))
     if p == 1 or n == 0:
         return {"flops": 0, "wire_bytes": 0, "hops": 0}
     wire_b = _WIRE_ITEMSIZE.get(wire or "", float(itemsize))
+    if (method == "hier" and group_size and 1 < group_size < p
+            and p % group_size == 0):
+        g, hosts = group_size, p // group_size
+        intra = 2.0 * n * (g - 1) / g
+        inter = 2.0 * (n / g) * (hosts - 1) / hosts
+        return {"flops": int(n * (p - 1) / p),
+                "wire_bytes": int(intra * itemsize + inter * wire_b),
+                "hops": 2 * (g - 1) + 2 * (hosts - 1)}
     elems = 2.0 * n * (p - 1) / p
     log2p = max(1, math.ceil(math.log2(p)))
     if method == "swing":
         hops = 2 * log2p
-    elif method in ("ring", "bidir"):
-        hops = 2 * (p - 1)
+    elif method in ("ring", "bidir", "hier"):
+        hops = 2 * (p - 1)  # hier w/o usable grouping degrades to ring
     else:  # tree / psum / psum_mask
         hops = 2 * log2p
-    return {"flops": int(n * (p - 1) / p),
+    flops = n * (p - 1) / p
+    if phase == "rs":
+        elems, hops = elems / 2, hops // 2
+    elif phase == "ag":
+        elems, hops, flops = elems / 2, hops // 2, 0
+    return {"flops": int(flops),
             "wire_bytes": int(elems * wire_b),
             "hops": hops}
 
@@ -201,12 +224,15 @@ class Profiler:
 
     def record_cost(self, name: str, method: Optional[str],
                     wire: Optional[str], n: int, itemsize: int,
-                    axis_size: int) -> Optional[Dict[str, Any]]:
+                    axis_size: int, phase: Optional[str] = None,
+                    group_size: Optional[int] = None
+                    ) -> Optional[Dict[str, Any]]:
         """Accumulate an analytic cost sample; returns the estimate so
         the caller can stamp it into its span, or None when disabled."""
         if not self._enabled:
             return None
-        est = collective_cost(method, n, itemsize, axis_size, wire)
+        est = collective_cost(method, n, itemsize, axis_size, wire,
+                              phase=phase, group_size=group_size)
         key = (name, method or "", wire or "")
         with self._lock:
             c = self._cost.setdefault(
@@ -307,8 +333,12 @@ def record_compile(tag: str, dur_s: float) -> None:
 
 
 def record_cost(name: str, method: Optional[str], wire: Optional[str],
-                n: int, itemsize: int, axis_size: int):
-    return _PROFILER.record_cost(name, method, wire, n, itemsize, axis_size)
+                n: int, itemsize: int, axis_size: int,
+                phase: Optional[str] = None,
+                group_size: Optional[int] = None):
+    return _PROFILER.record_cost(name, method, wire, n, itemsize,
+                                 axis_size, phase=phase,
+                                 group_size=group_size)
 
 
 def sample_memory():

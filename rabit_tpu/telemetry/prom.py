@@ -67,6 +67,8 @@ METRIC_FAMILIES = (
     # tracker fleet gauges (tracker/tracker.py)
     "rabit_tracker_endpoints",
     "rabit_tracker_polls_total",
+    "rabit_tracker_topology_hosts",
+    "rabit_tracker_topology_ranks_per_host",
     "rabit_straggler_lag_collectives",
     "rabit_straggler_busy_skew_seconds",
 )

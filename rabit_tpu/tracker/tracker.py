@@ -25,6 +25,15 @@ Wire protocol (binary, little-endian, length-prefixed strings):
                    that worker's live /metrics endpoint listens; the
                    tracker's poller scrapes it on an interval while the
                    run is live — see telemetry/live.py)
+    topo:          (no extra fields) tracker -> worker: payload str, a
+                   JSON {"epoch","groups","delegates","single_host"}
+                   document of the host topology observed at the last
+                   completed assignment — ranks grouped by the host
+                   fingerprint of the endpoint announce path (observed
+                   registration source IP, falling back to the reported
+                   hostname), plus the elected min-rank delegate per
+                   host. "{}" before the first assignment. Feeds the
+                   hierarchical collectives (parallel/topology.py).
   tracker -> worker (start/recover): rank u32, world u32, epoch u32,
     coord_host str, coord_port u32 (this epoch's tracker-hosted device
     -world coordination service; empty/0 when coordinator hosting is
@@ -102,24 +111,14 @@ FLAG_DATAPLANE = 1  # registration flags bit 0
 
 
 def _require_coordinator_api():
-    """The coordinator service rides jaxlib private APIs
-    (``jax._src.lib._jax.get_distributed_runtime_service``), verified
-    against jax/jaxlib 0.9.x. Fail loudly at setup — not mid-recovery —
-    when a jax upgrade removed them (VERDICT r2 weak #7)."""
-    try:
-        from jax._src.lib import _jax
-    except ImportError as e:  # pragma: no cover - jax always present here
-        raise RuntimeError(
-            "rabit_tpu device-world coordination requires jax") from e
-    if not hasattr(_jax, "get_distributed_runtime_service"):
-        import jaxlib
-        raise RuntimeError(
-            "jaxlib private API 'get_distributed_runtime_service' is "
-            f"missing in jaxlib {getattr(jaxlib, '__version__', '?')} — "
-            "the XLA data plane's coordinator contract is verified "
-            "against jaxlib 0.9.x; pin jaxlib or run without "
-            "rabit_dataplane=xla")
-    return _jax
+    """The coordinator service rides jaxlib private APIs; the module
+    path and kwarg spellings moved between jax 0.4.x and 0.9.x, so the
+    probe and translation live in ``utils/jaxcompat.py``. Fail loudly
+    at setup — not mid-recovery — when a jax upgrade removed them
+    (VERDICT r2 weak #7)."""
+    from ..utils import jaxcompat
+    jaxcompat.distributed_runtime_module()
+    return jaxcompat
 
 
 def _default_ready_timeout() -> float:
@@ -198,6 +197,9 @@ class Tracker:
         self._poll_stop = threading.Event()
         self._poll_count = 0
         self._last_straggler: Optional[dict] = None
+        # host topology of the last completed assignment (the ``topo``
+        # wire command's payload); {} until a batch assigns
+        self._topo: dict = {}
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Tracker":
@@ -241,7 +243,7 @@ class Tracker:
         IPv6 wildcard), with an IPv4 fallback for IPv6-disabled hosts;
         the bind-close-start race remains but is at least sampling the
         right namespace."""
-        _jax = _require_coordinator_api()
+        compat = _require_coordinator_api()
         last_err: Optional[Exception] = None
         for family, bind_host, fmt in (
                 (socket.AF_INET6, "::", "[::]:{p}"),
@@ -260,11 +262,10 @@ class Tracker:
             finally:
                 probe.close()
             try:
-                svc = _jax.get_distributed_runtime_service(
-                    fmt.format(p=port), self.nworkers,
-                    heartbeat_timeout=1 << 20,  # failure detection is
-                    # the socket control plane's job, not the service's
-                    shutdown_timeout=1)
+                # liveness detection off in the service: failure
+                # detection is the socket control plane's job
+                svc = compat.start_service(fmt.format(p=port),
+                                           self.nworkers)
             except Exception as e:  # noqa: BLE001 - retried on next family
                 last_err = e
                 continue
@@ -343,6 +344,7 @@ class Tracker:
             nend = len(self._endpoints)
             polls = self._poll_count
             strag = self._last_straggler
+            topo = dict(self._topo)
         gauges = [
             ("rabit_tracker_endpoints",
              "Worker metrics endpoints known to the tracker.",
@@ -350,6 +352,18 @@ class Tracker:
             ("rabit_tracker_polls_total",
              "Completed endpoint poll sweeps.", "counter", [({}, polls)]),
         ]
+        if topo.get("groups"):
+            sizes = [len(g) for g in topo["groups"]]
+            gauges.append((
+                "rabit_tracker_topology_hosts",
+                "Distinct hosts in the current link-registration epoch.",
+                "gauge", [({}, len(sizes))]))
+            gauges.append((
+                "rabit_tracker_topology_ranks_per_host",
+                "Ranks per host (max label distinguishes ragged "
+                "groupings, which disable the hierarchical schedule).",
+                "gauge", [({"stat": "min"}, min(sizes)),
+                          ({"stat": "max"}, max(sizes))]))
         if strag is not None and strag.get("lagging_rank") is not None:
             gauges.append((
                 "rabit_straggler_lag_collectives",
@@ -496,6 +510,11 @@ class Tracker:
                             "rank": int(doc.get("rank", -1))}
                 _send_u32(conn, 1 if ok else 0)
                 conn.close()
+            elif cmd == "topo":
+                with self._lock:
+                    doc = dict(self._topo)
+                _send_str(conn, json.dumps(doc))
+                conn.close()
             elif cmd == "shutdown":
                 with self._lock:
                     rank = self._ranks.get(task_id)
@@ -589,6 +608,25 @@ class Tracker:
                 return None  # died pre-assignment; be conservative
         single_host = len({_src_ip(c) for (c, h, p, f, tok) in
                            batch.values()}) <= 1
+        # Host grouping for hierarchical collectives (the ``topo``
+        # command): ranks sharing a fingerprint share a host. Same
+        # src-ip-first rule as single_host (hostnames lie across cloned
+        # VMs); the reported hostname only breaks ties when the source
+        # address is unknown. Like single_host this steers SCHEDULE
+        # choice only — data never rides an inferred-same-host path
+        # (UDS still proves locality per-pair via uds_token).
+        by_host: Dict[str, List[int]] = {}
+        for rank in sorted(batch):
+            c, h, p, f, tok = batch[rank]
+            by_host.setdefault(_src_ip(c) or h, []).append(rank)
+        groups = list(by_host.values())
+        with self._lock:
+            self._topo = {
+                "epoch": epoch,
+                "groups": groups,
+                "delegates": [min(g) for g in groups],
+                "single_host": single_host,
+            }
         for rank in sorted(batch):
             conn = conns[rank]
             parent, children = tree_neighbors(rank, world)

@@ -32,6 +32,9 @@ ENV_VARS = [
     "RABIT_DATAPLANE_WIRE",
     "RABIT_DATAPLANE_WIRE_MINCOUNT",
     "RABIT_REDUCE_METHOD",
+    "RABIT_HIER",
+    "RABIT_HIER_GROUP",
+    "RABIT_HIER_PHASE_DEADLINE_SCALE",
     "RABIT_TELEMETRY",
     "RABIT_TELEMETRY_BUFFER",
     "RABIT_TELEMETRY_EXPORT",

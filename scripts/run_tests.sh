@@ -41,6 +41,13 @@ python -m rabit_tpu.telemetry --smoke
 echo "== tier 0e: regression-sentinel smoke (ingest -> MAD gate) =="
 python tools/bench_sentinel.py --smoke
 
+echo "== tier 0f: hierarchical dispatch smoke (sweep incl. hier column) =="
+# one tiny size through every method — including the two-level hier
+# schedule under a forced 2-ranks-per-host grouping — and the emitted
+# table must round-trip through the dispatch loader
+JAX_PLATFORMS=cpu python tools/collective_sweep.py --smoke \
+    --out /tmp/rabit_sweep_smoke.json
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

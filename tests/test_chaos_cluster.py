@@ -83,6 +83,42 @@ def test_collectives_survive_link_resets():
     assert stats["chaos"]["events"] >= 1, "no reset ever fired"
 
 
+def test_hier_collectives_survive_link_resets():
+    """The two-level schedule under mid-collective link RSTs: a 4-rank
+    device-plane world forced into 2 simulated hosts
+    (RABIT_HIER_GROUP=2) runs every coded-op payload through
+    hierarchical device allreduce while each link proxy — the
+    delegates' (ranks 0 and 2) included — hard-resets its first busy
+    connection once enough control-plane bytes passed. An RST mid-run
+    strands the reset ranks' peers inside the abandoned gloo program
+    with no socket error to react to, so the watchdog deadline is
+    load-bearing here: it aborts the stuck ranks (exit 86), the
+    tracker respawns them, and the device world re-forms — without the
+    deadline this scenario stalls forever. N_ITER is high because with
+    payloads on the device plane only control traffic crosses the
+    links; the growing broadcast payloads push the trigger byte count
+    past bootstrap and into mid-collective territory (an RST during
+    link wiring is unrecoverable by design, see the first test)."""
+    chaos = {"seed": 9, "rules": [
+        {"kind": "reset", "after_bytes": 4096, "max_times": 1,
+         "target": "link"}]}
+    rc, stats = run_cluster(
+        4, "recover_worker.py", chaos=chaos,
+        extra_args=["rabit_dataplane=xla", "rabit_dataplane_minbytes=0",
+                    "rabit_deadline_ms=5000"],
+        env={"RABIT_DATAPLANE": "xla", "RABIT_DATAPLANE_MINBYTES": "0",
+             "RABIT_REDUCE_METHOD": "hier", "RABIT_HIER_GROUP": "2",
+             "RABIT_TELEMETRY": "1", "N_ITER": "40"}, timeout=240)
+    assert rc == 0
+    assert stats["chaos"]["events"] >= 1, "no reset ever fired"
+    names = _counter_names(stats)
+    assert ("recovery.world_reform", "recovery") in names, names
+    # the fleet summary must show all three hierarchical phases ran
+    span_names = {n for n, _ in names}
+    for phase in ("hier.reduce_scatter", "hier.inter", "hier.allgather"):
+        assert phase in span_names, (phase, sorted(span_names))
+
+
 def test_partition_expires_watchdog_and_recovers():
     """A partition window stalls the stream without any socket error —
     invisible to the epoch machinery, visible to the watchdog. With

@@ -81,6 +81,8 @@ def test_load_table_env_disable(monkeypatch, table_file):
     lambda d: d["table"]["float_sum"][-1].__setitem__("max_n", 999),
     lambda d: d["table"]["other"][0].__setitem__("method", "quantum"),
     lambda d: d["table"]["float_sum"][0].__setitem__("wire", "fp4"),
+    # "flat" (hier degradation target) must itself be a flat method
+    lambda d: d["table"]["float_sum"][0].__setitem__("flat", "hier"),
 ])
 def test_load_table_rejects_malformed(tmp_path, monkeypatch, mutate):
     bad = json.loads(json.dumps(VALID_TABLE))
@@ -148,10 +150,71 @@ def test_resolve_swing_nonpow2_degrades(no_table):
 
 def test_resolve_explicit_method_passthrough(no_table):
     f32 = np.dtype(np.float32)
+    groups = ((0, 1, 2, 3), (4, 5, 6, 7))  # hier needs a real grouping
     for m in dispatch.METHODS:
-        assert dispatch.resolve(100, f32, SUM, 8, method=m)[0] == m
+        assert dispatch.resolve(100, f32, SUM, 8, method=m,
+                                groups=groups)[0] == m
     with pytest.raises(ValueError, match="method"):
         dispatch.resolve(100, f32, SUM, 8, method="bogus")
+
+
+def test_resolve_hier_degrades_without_grouping(no_table, monkeypatch):
+    """Explicit hier on a world with no usable host grouping runs the
+    flat ring — the same degradation contract as swing on a
+    non-power-of-two world. Degenerate groupings (all ranks one host,
+    one rank per host, ragged) count as unusable."""
+    monkeypatch.delenv("RABIT_HIER", raising=False)
+    monkeypatch.delenv("RABIT_HIER_GROUP", raising=False)
+    f32 = np.dtype(np.float32)
+    assert dispatch.resolve(100, f32, SUM, 8, method="hier")[0] == "ring"
+    # all-one-host and one-rank-per-host are flat worlds
+    one_host = (tuple(range(8)),)
+    per_rank = tuple((i,) for i in range(8))
+    ragged = ((0, 1, 2), (3, 4, 5, 6, 7))
+    for g in (one_host, per_rank, ragged):
+        assert dispatch.resolve(100, f32, SUM, 8, method="hier",
+                                groups=g)[0] == "ring"
+    # rabit_hier=0 disables the schedule even with a genuine grouping
+    monkeypatch.setenv("RABIT_HIER", "0")
+    good = ((0, 1, 2, 3), (4, 5, 6, 7))
+    assert dispatch.resolve(100, f32, SUM, 8, method="hier",
+                            groups=good)[0] == "ring"
+
+
+def test_resolve_hier_table_row_consults_grouping(tmp_path, monkeypatch):
+    """An auto-dispatch table row saying hier engages only when the
+    grouping is genuinely two-level; otherwise the row's ``flat``
+    column applies."""
+    monkeypatch.delenv("RABIT_HIER", raising=False)
+    monkeypatch.delenv("RABIT_HIER_GROUP", raising=False)
+    monkeypatch.delenv("RABIT_DATAPLANE_WIRE", raising=False)
+    table = {
+        "schema": dispatch.SCHEMA,
+        "table": {
+            "float_sum": [
+                {"max_n": 10000, "method": "tree", "wire": None},
+                {"max_n": None, "method": "hier", "wire": None,
+                 "flat": "bidir"},
+            ],
+            "other": [{"max_n": None, "method": "ring", "wire": None}],
+        },
+    }
+    p = tmp_path / "COLLECTIVE_SWEEP_hier.json"
+    p.write_text(json.dumps(table))
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", str(p))
+    dispatch.clear_cache()
+    try:
+        f32 = np.dtype(np.float32)
+        groups = ((0, 1, 2, 3), (4, 5, 6, 7))
+        assert dispatch.resolve(10**6, f32, SUM, 8,
+                                groups=groups)[0] == "hier"
+        assert dispatch.resolve(10**6, f32, SUM, 8)[0] == "bidir"
+        # grouping present but hierarchy disabled -> flat column too
+        monkeypatch.setenv("RABIT_HIER", "0")
+        assert dispatch.resolve(10**6, f32, SUM, 8,
+                                groups=groups)[0] == "bidir"
+    finally:
+        dispatch.clear_cache()
 
 
 def test_resolve_consults_table(table_file):

@@ -1,0 +1,248 @@
+"""Hierarchical topology-aware collectives (two-level allreduce over a
+host-delegate fabric) plus the promoted reduce-scatter / all-gather
+primitives, on the 8-device virtual CPU mesh.
+
+The grouping is forced (``parallel/topology.py`` specs) since the
+virtual mesh has no real host boundary — the same override knob
+(``rabit_hier_group``) a deployment uses; the tracker-discovery path is
+covered in test_tracker.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from rabit_tpu.ops.reducers import SUM, MAX, MIN
+from rabit_tpu.parallel import (
+    make_mesh, device_allreduce,
+    device_reduce_scatter, device_allgather, device_hier_allreduce,
+)
+from rabit_tpu.parallel.collectives import shard_over
+from rabit_tpu.parallel import topology
+
+NDEV = len(jax.devices())
+
+pytestmark = pytest.mark.skipif(NDEV < 8, reason="needs 8 virtual devices")
+
+G2 = ((0, 1), (2, 3), (4, 5), (6, 7))   # 4 hosts x 2 ranks
+G4 = ((0, 1, 2, 3), (4, 5, 6, 7))       # 2 hosts x 4 ranks
+ONE_HOST = (tuple(range(8)),)           # degenerate: pure intra
+PER_RANK = tuple((i,) for i in range(8))  # degenerate: pure inter
+
+
+def _rand(p, n, dtype, seed=0):
+    rng = np.random.default_rng(seed)
+    if np.dtype(dtype).kind in "ui":
+        return rng.integers(0, 100, size=(p, n)).astype(dtype)
+    return rng.standard_normal((p, n)).astype(dtype)
+
+
+@pytest.fixture
+def no_hier_env(monkeypatch):
+    monkeypatch.delenv("RABIT_HIER", raising=False)
+    monkeypatch.delenv("RABIT_HIER_GROUP", raising=False)
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", "none")
+
+
+# ------------------------------------------------------------- topology
+
+
+def test_parse_groups_int_spec():
+    assert topology.parse_groups("2", 8) == G2
+    assert topology.parse_groups(4, 8) == G4
+    assert topology.parse_groups("1", 8) is None   # g=1: flat
+    with pytest.raises(ValueError, match="divide"):
+        topology.parse_groups("3", 8)
+
+
+def test_parse_groups_explicit_spec():
+    assert topology.parse_groups("0,1|2,3", 4) == ((0, 1), (2, 3))
+    # off-words and auto defer to discovery / flat
+    for s in (None, "", "auto", "none", "off", "0"):
+        assert topology.parse_groups(s, 8) is None
+    with pytest.raises(ValueError):
+        topology.parse_groups("0,1|1,2", 4)   # rank 1 twice, 3 missing
+    with pytest.raises(ValueError):
+        topology.parse_groups("0,1|2,x", 4)
+
+
+def test_normalize_groups_requires_partition():
+    with pytest.raises(ValueError):
+        topology.normalize_groups([[0, 1], [2]], 8)  # not all ranks
+    with pytest.raises(ValueError):
+        topology.normalize_groups([[0, 1], [1, 2, 3]], 4)  # duplicate
+
+
+def test_resolve_groups_precedence(monkeypatch):
+    monkeypatch.setenv("RABIT_HIER_GROUP", "4")
+    monkeypatch.delenv("RABIT_HIER", raising=False)
+    assert topology.resolve_groups(8) == G4            # env
+    assert topology.resolve_groups(8, spec="2") == G2  # spec beats env
+    assert topology.resolve_groups(8, explicit=G2) == G2
+    monkeypatch.setenv("RABIT_HIER", "0")              # kill switch
+    assert topology.resolve_groups(8) is None
+    assert topology.resolve_groups(8, explicit=G2) is None
+
+
+def test_is_hierarchical_degenerate_worlds():
+    assert topology.is_hierarchical(G2, 8)
+    assert topology.is_hierarchical(G4, 8)
+    assert not topology.is_hierarchical(None, 8)
+    assert not topology.is_hierarchical(ONE_HOST, 8)   # 1 host
+    assert not topology.is_hierarchical(PER_RANK, 8)   # 1 rank/host
+    # ragged groupings break the SPMD slot rings
+    assert not topology.is_hierarchical(((0, 1, 2), (3, 4, 5, 6, 7)), 8)
+
+
+def test_delegates_and_slot_rings():
+    assert topology.delegates(G2) == (0, 2, 4, 6)
+    assert topology.slot_rings(G2) == ((0, 2, 4, 6), (1, 3, 5, 7))
+    assert topology.slot_rings(G4) == (
+        (0, 4), (1, 5), (2, 6), (3, 7))
+
+
+def test_groups_spec_round_trip():
+    spec = topology.groups_spec(G2)
+    assert topology.parse_groups(spec, 8) == G2
+
+
+def test_group_by_fingerprint():
+    fps = ["a", "a", "b", "b", "a", "c"]
+    assert topology.group_by_fingerprint(fps) == ((0, 1, 4), (2, 3), (5,))
+
+
+# --------------------------------------------------- hierarchical device
+
+
+@pytest.mark.parametrize("groups", [G2, G4])
+@pytest.mark.parametrize("op,dtype", [
+    (SUM, np.int32), (MAX, np.int32), (MIN, np.int32), (SUM, np.uint32)])
+def test_hier_bitexact_vs_ring_int(no_hier_env, groups, op, dtype):
+    """Integer reductions are exact arithmetic: the two-level schedule
+    must be BIT-EXACT against the flat ring, padding and all (sizes
+    straddle the p*g chunking: 1 element, prime, round)."""
+    mesh = make_mesh(8)
+    for n in (1, 257, 4096):
+        xs = _rand(8, n, dtype, seed=n)
+        flat = np.asarray(device_allreduce(
+            shard_over(mesh, xs), mesh, op, method="ring"))
+        hier = np.asarray(device_allreduce(
+            shard_over(mesh, xs), mesh, op, method="hier", groups=groups))
+        np.testing.assert_array_equal(hier, flat)
+
+
+@pytest.mark.parametrize("groups", [G2, G4])
+def test_hier_float_sum_matches(no_hier_env, groups):
+    """Float SUM differs from the flat ring only by association."""
+    mesh = make_mesh(8)
+    xs = _rand(8, 10000, np.float32)
+    out = np.asarray(device_allreduce(
+        shard_over(mesh, xs), mesh, SUM, method="hier", groups=groups))
+    np.testing.assert_allclose(out, xs.sum(0), rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("groups", [ONE_HOST, PER_RANK])
+def test_hier_degenerate_short_circuits(no_hier_env, groups):
+    """All-ranks-one-host and one-rank-per-host are flat worlds: the
+    hier schedule short-circuits to a single-level ring and stays
+    correct (the dispatch-level degradation is test_dispatch.py)."""
+    mesh = make_mesh(8)
+    xs = _rand(8, 1000, np.int32, seed=3)
+    want = np.asarray(device_allreduce(
+        shard_over(mesh, xs), mesh, SUM, method="ring"))
+    got = np.asarray(device_allreduce(
+        shard_over(mesh, xs), mesh, SUM, method="hier", groups=groups))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hier_wire_quantized_inter(no_hier_env):
+    """Wire quantization applies to the inter-host phase only; the
+    result stays close to exact (EQuARX-style bounded error)."""
+    mesh = make_mesh(8)
+    xs = _rand(8, 300000, np.float32)
+    want = xs.sum(0)
+    for wire in ("bf16", "int8"):
+        out = np.asarray(device_allreduce(
+            shard_over(mesh, xs), mesh, SUM, method="hier", groups=G2,
+            wire=wire))
+        err = np.abs(out - want).max() / np.abs(want).max()
+        assert err < 5e-2, f"wire={wire} err={err}"
+
+
+def test_device_hier_allreduce_phased(no_hier_env):
+    """The observable (3-program) composition agrees with the flat ring
+    and with the fused hier dispatch path."""
+    mesh = make_mesh(8)
+    xs = _rand(8, 5000, np.int32, seed=7)
+    want = np.asarray(device_allreduce(
+        shard_over(mesh, xs), mesh, SUM, method="ring"))
+    got = np.asarray(device_hier_allreduce(
+        shard_over(mesh, xs), mesh, SUM, groups=G2))
+    np.testing.assert_array_equal(got, want)
+    # degenerate grouping short-circuits to the flat engine path
+    got1 = np.asarray(device_hier_allreduce(
+        shard_over(mesh, xs), mesh, SUM, groups=ONE_HOST))
+    np.testing.assert_array_equal(got1, want)
+
+
+def test_device_hier_allreduce_phase_guard_runs(no_hier_env):
+    """The per-phase guard factory is entered once per phase with the
+    phase's span name and a sane byte count."""
+    import contextlib
+    mesh = make_mesh(8)
+    xs = _rand(8, 4096, np.float32)
+    seen = []
+
+    def guard(name, nbytes):
+        seen.append((name, nbytes))
+        return contextlib.nullcontext()
+
+    device_hier_allreduce(shard_over(mesh, xs), mesh, SUM, groups=G2,
+                          phase_guard=guard)
+    names = [n for n, _ in seen]
+    assert names == ["hier.reduce_scatter", "hier.inter", "hier.allgather"]
+    assert all(b > 0 for _, b in seen)
+
+
+# ------------------------------------------- first-class RS/AG primitives
+
+
+def test_device_reduce_scatter_ownership():
+    mesh = make_mesh(8)
+    xs = _rand(8, 8 * 100, np.float32)
+    out = device_reduce_scatter(shard_over(mesh, xs), mesh, SUM)
+    want = xs.sum(0)
+    np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5, atol=1e-5)
+    # rank i's addressable shard IS chunk i (the ownership layout)
+    for i, shard in enumerate(out.addressable_shards):
+        np.testing.assert_allclose(
+            np.asarray(shard.data).reshape(-1),
+            want[i * 100:(i + 1) * 100], rtol=1e-5, atol=1e-5)
+
+
+def test_device_reduce_scatter_rejects_indivisible():
+    mesh = make_mesh(8)
+    xs = _rand(8, 257, np.float32)
+    with pytest.raises(ValueError, match="divide"):
+        device_reduce_scatter(shard_over(mesh, xs), mesh, SUM)
+
+
+def test_device_allgather_rank_order():
+    mesh = make_mesh(8)
+    xs = _rand(8, 33, np.int32)
+    out = np.asarray(device_allgather(shard_over(mesh, xs), mesh))
+    np.testing.assert_array_equal(out, xs.reshape(-1))
+
+
+def test_rs_ag_compose_to_allreduce():
+    """allreduce == reduce_scatter ∘ allgather — the decomposition the
+    hierarchical schedule is built from."""
+    mesh = make_mesh(8)
+    xs = _rand(8, 8 * 64, np.float32)
+    mid = device_reduce_scatter(shard_over(mesh, xs), mesh, SUM)
+    # re-stage each rank's owned chunk as its allgather contribution
+    chunks = np.stack([np.asarray(s.data).reshape(-1)
+                       for s in mid.addressable_shards])
+    out = np.asarray(device_allgather(shard_over(mesh, chunks), mesh))
+    np.testing.assert_allclose(out, xs.sum(0), rtol=1e-5, atol=1e-5)

@@ -177,9 +177,14 @@ def test_quantized_wire_data_plane(wire):
     """EQuARX-style wire quantization end to end through the robust+XLA
     engine (rabit_dataplane_wire): float SUMs land within the wire's
     error envelope and BIT-IDENTICAL on every rank — the property that
-    keeps result-log replay consistent under a compressed wire."""
+    keeps result-log replay consistent under a compressed wire. The
+    ring method and an explicit zero mincount force the wire on — the
+    point is the codec over the data plane, not the crossover policy
+    (this machine's measured table never elects a wire)."""
     assert run_xla(4, "wire_worker.py",
-                   extra_args=[f"rabit_dataplane_wire={wire}"],
+                   extra_args=[f"rabit_dataplane_wire={wire}",
+                               "rabit_reduce_method=ring",
+                               "rabit_dataplane_wire_mincount=0"],
                    env={"RABIT_DATAPLANE_WIRE": wire}) == 0
 
 
@@ -190,8 +195,11 @@ def test_quantized_wire_survives_recovery(wire):
     those cached (quantized-sum) results must land byte-equal to what
     every survivor holds — checked per round via CRC MIN==MAX. int8 is
     the format where replay byte-drift is most plausible (per-block
-    scale computation), so both modes run."""
+    scale computation), so both modes run. Ring + zero mincount force
+    the wire on (see test_quantized_wire_data_plane)."""
     assert run_xla(4, "wire_worker.py",
                    extra_args=[f"rabit_dataplane_wire={wire}",
+                               "rabit_reduce_method=ring",
+                               "rabit_dataplane_wire_mincount=0",
                                "mock=1,1,0,0"],
                    env={"RABIT_DATAPLANE_WIRE": wire, "N_ITER": "3"}) == 0

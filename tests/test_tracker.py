@@ -284,17 +284,63 @@ def test_coordinator_services_reaped_across_epochs():
 def test_private_api_guard_dataplane(monkeypatch):
     """A jax upgrade that removes the private client API must fail at
     data-plane construction with a pinned, actionable error (VERDICT r2
-    weak #7) — not mid-recovery."""
-    from jax._src.lib import _jax
+    weak #7) — not mid-recovery. Goes through the jaxcompat probe so
+    the test holds on every jax this repo supports (0.4.x and 0.9.x
+    stash the bindings in different modules)."""
     from rabit_tpu.engine.dataplane import XlaDataPlane
-    monkeypatch.delattr(_jax, "get_distributed_runtime_client")
-    with pytest.raises(RuntimeError, match="jaxlib 0.9.x"):
+    from rabit_tpu.utils import jaxcompat
+    mod = jaxcompat.distributed_runtime_module()
+    monkeypatch.delattr(mod, "get_distributed_runtime_client")
+    with pytest.raises(RuntimeError, match="pin jaxlib"):
         XlaDataPlane(lib=None)
 
 
 def test_private_api_guard_coordinator(monkeypatch):
-    from jax._src.lib import _jax
     from rabit_tpu.tracker.tracker import _require_coordinator_api
-    monkeypatch.delattr(_jax, "get_distributed_runtime_service")
-    with pytest.raises(RuntimeError, match="jaxlib 0.9.x"):
+    from rabit_tpu.utils import jaxcompat
+    mod = jaxcompat.distributed_runtime_module()
+    monkeypatch.delattr(mod, "get_distributed_runtime_service")
+    with pytest.raises(RuntimeError, match="pin jaxlib"):
         _require_coordinator_api()
+
+
+def test_topo_command_serves_discovered_grouping():
+    """The ``topo`` wire command serves the host grouping discovered at
+    assignment time: before any epoch there is nothing to serve (a
+    worker bootstrapping against a fresh tracker gets a flat world, not
+    an error), after assignment the ranks group by the host fingerprint
+    seen on the announce path — both FakeWorkers register from
+    127.0.0.1, so they land in one group with rank 0 the delegate."""
+    import json as _json
+
+    from rabit_tpu.parallel import topology
+
+    tr = Tracker(2, ready_timeout=5.0).start()
+    try:
+        # pre-assignment: the client helper degrades to None (flat)
+        assert topology.fetch_topo(tr.host, tr.port, timeout=5.0) is None
+        a = FakeWorker(tr, "a")
+        b = FakeWorker(tr, "b")
+        ra, rb = a.read_assignment(), b.read_assignment()
+        a.ack()
+        b.ack()
+        # the client helper the native engine uses at bootstrap
+        groups = topology.fetch_topo(tr.host, tr.port, timeout=5.0)
+        assert groups == ((0, 1),)
+        assert not topology.is_hierarchical(groups, 2)  # one host: flat
+        # raw wire shape: MAGIC, cmd, task_id, attempt -> one JSON str
+        s = socket.create_connection((tr.host, tr.port), timeout=10)
+        _send_u32(s, MAGIC)
+        _send_str(s, "topo")
+        _send_str(s, "probe")
+        _send_u32(s, 0)
+        doc = _json.loads(_recv_str(s))
+        s.close()
+        assert doc["groups"] == [[0, 1]]
+        assert doc["delegates"] == [0]
+        assert doc["epoch"] == ra["epoch"] == rb["epoch"]
+        assert doc["single_host"] in (True, 1)
+        a.close()
+        b.close()
+    finally:
+        tr.stop()

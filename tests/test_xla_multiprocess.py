@@ -69,6 +69,17 @@ def test_xla_engine_reduce_method(method):
 
 
 @pytest.mark.slow
+def test_xla_engine_hier_two_simulated_hosts():
+    """Two-level hierarchical allreduce end-to-end on a real 4-process
+    gloo world forced into 2 simulated hosts (rabit_hier_group=2):
+    engine-path SUM/MAX bit-exact across dtypes (integer-valued
+    payloads make float SUM association-free, so 'same math' means
+    'same bits'), cross-rank CRC identity, and a direct device-level
+    ring-vs-hier comparison on the same staged global array."""
+    _run_world(4, mode="hier", timeout=240)
+
+
+@pytest.mark.slow
 def test_xla_engine_broadcast_variants():
     """Two-phase pickle broadcast at true process granularity: large
     array payload and a non-zero root."""

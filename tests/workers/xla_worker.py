@@ -13,6 +13,10 @@ mode (default "base") selects the exercise:
   bidir / swing
               rabit_reduce_method config plumbed end-to-end (engine ->
               env export -> dispatch -> per-shard schedule)
+  hier        two-level hierarchical schedule on a 4-process world
+              forced into 2 simulated hosts (rabit_hier_group=2):
+              engine-path SUM/MAX bit-exact across dtypes plus a
+              direct device-level ring-vs-hier comparison
   bcast       large-array + non-zero-root broadcast variants
 """
 
@@ -56,6 +60,10 @@ def main() -> None:
                 "rabit_dataplane_wire_mincount=0"]
     elif mode in ("bidir", "swing"):
         cfg += [f"rabit_reduce_method={mode}"]
+    elif mode == "hier":
+        # 4 procs forced into 2 simulated hosts of 2: every engine
+        # collective below runs the two-level schedule on real gloo
+        cfg += ["rabit_reduce_method=hier", "rabit_hier_group=2"]
     rabit.init(cfg)
     r, w = rabit.get_rank(), rabit.get_world_size()
     assert w == int(nproc), (r, w)
@@ -77,6 +85,58 @@ def main() -> None:
         np.testing.assert_allclose(got, want, rtol=rtol,
                                    atol=rtol * np.abs(want).max())
         _assert_ranks_identical(got, r)
+    elif mode == "hier":
+        # engine path: integer-valued payloads make SUM association-free,
+        # so the two-level schedule must be BIT-exact against the
+        # analytic answer for every dtype — float included
+        base = np.arange(9973) % 101
+        for dt in (np.int32, np.int64, np.float32, np.float64):
+            got = rabit.allreduce((base + r).astype(dt), rabit.SUM)
+            assert got.dtype == np.dtype(dt), (r, got.dtype)
+            assert np.array_equal(got, (base * w + sum(range(w))
+                                        ).astype(dt)), (r, dt, got[:4])
+            got = rabit.allreduce((base + r).astype(dt), rabit.MAX)
+            assert np.array_equal(got, (base + (w - 1)).astype(dt)), \
+                (r, dt, got[:4])
+        # float SUM on arbitrary values: allclose + CRC rank-identity
+        # (SPMD: every rank runs one program, so bytes must agree)
+        rng = np.random.default_rng(13)
+        fs = rng.standard_normal(50_000).astype(np.float32)
+        got = rabit.allreduce(fs + r, rabit.SUM)
+        np.testing.assert_allclose(got, fs * w + sum(range(w)), rtol=1e-5,
+                                   atol=1e-4)
+        _assert_ranks_identical(got, r)
+
+        # device level: hier vs flat ring on the SAME staged global
+        # array over the real gloo fabric, bit-for-bit (integer-valued
+        # data again, odd length to exercise the pad/slice path)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from rabit_tpu.parallel.collectives import device_allreduce
+        eng = rabit._engine
+        mesh = eng._mesh
+        assert eng._groups == ((0, 1), (2, 3)), eng._groups
+
+        def stage(arr):
+            local = jax.device_put(arr.reshape(1, -1),
+                                   mesh.local_devices[0])
+            return jax.make_array_from_single_device_arrays(
+                (w, arr.size), NamedSharding(mesh, P("proc")), [local])
+
+        prng = np.random.default_rng(100 + r)
+        vals = prng.integers(-50, 50, 4099)
+        for op in (rabit.SUM, rabit.MAX):
+            for dt in (np.int32, np.float32):
+                arr = vals.astype(dt)
+                ring = np.asarray(device_allreduce(
+                    stage(arr), mesh, op, axis="proc",
+                    method="ring").addressable_data(0)).reshape(-1)
+                hier = np.asarray(device_allreduce(
+                    stage(arr), mesh, op, axis="proc", method="hier",
+                    groups=((0, 1), (2, 3))).addressable_data(0)
+                ).reshape(-1)
+                assert hier.dtype == ring.dtype, (op, dt, hier.dtype)
+                assert np.array_equal(ring, hier), \
+                    (r, op, dt, ring[:4], hier[:4])
     elif mode in ("bidir", "swing"):
         big = rabit.allreduce(np.full(150_000, float(r + 1), np.float32),
                               rabit.SUM)

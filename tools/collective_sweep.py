@@ -1,11 +1,16 @@
 #!/usr/bin/env python
 """Measured crossover sweep for the collective dispatch table.
 
-Times {tree, ring, bidir, swing} x {wire none/bf16/int8} x payload
-sizes on the device mesh (virtual CPU mesh by default — the same gloo
-fabric the XLA data plane uses in tests; on a real TPU slice the same
-sweep measures ICI) and derives the per-size-bucket dispatch table that
-``device_allreduce(method="auto")`` loads (parallel/dispatch.py).
+Times {tree, ring, bidir, swing, hier} x {wire none/bf16/int8} x
+payload sizes on the device mesh (virtual CPU mesh by default — the
+same gloo fabric the XLA data plane uses in tests; on a real TPU slice
+the same sweep measures ICI) and derives the per-size-bucket dispatch
+table that ``device_allreduce(method="auto")`` loads
+(parallel/dispatch.py). The ``hier`` column runs the two-level
+host-grouped schedule under a forced ``--ranks-per-host`` grouping
+(the virtual mesh has no real host boundary); when a hier bucket wins,
+the row carries a ``flat`` field naming the best flat method — what
+auto-dispatch degrades to on worlds without a usable host grouping.
 
 Methodology is the repo's slope timing (utils/slope.py): k collectives
 chained inside ONE jitted dispatch via ``lax.fori_loop``, slope of
@@ -61,7 +66,7 @@ def _ensure_devices(world: int) -> None:
         ).strip()
 
 
-def _make_run(mesh, axis, n, dtype, op, method, wire):
+def _make_run(mesh, axis, n, dtype, op, method, wire, groups=None):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -75,7 +80,8 @@ def _make_run(mesh, axis, n, dtype, op, method, wire):
         x = x.reshape(-1)
 
         def body(_, acc):
-            r = _per_shard_allreduce(acc + salt, axis, op, method, wire)
+            r = _per_shard_allreduce(acc + salt, axis, op, method, wire,
+                                     groups=groups)
             if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
                 return 0.5 * r / p + 0.5 * acc
             return jnp.clip(r // p, 0, 1 << 20) + salt
@@ -94,7 +100,8 @@ def _make_run(mesh, axis, n, dtype, op, method, wire):
     return lambda k, salt: f(xs, jnp.asarray(salt, dtype), k)
 
 
-def _check_correct(mesh, axis, method, wire, dtype, op) -> None:
+def _check_correct(mesh, axis, method, wire, dtype, op,
+                   groups=None) -> None:
     """A broken schedule must not win a timing race: verify the method
     against the dense reduction once per (method, wire) combination."""
     import jax
@@ -115,17 +122,18 @@ def _check_correct(mesh, axis, method, wire, dtype, op) -> None:
         tol = 0
     got = np.asarray(device_allreduce(
         jax.device_put(xs, NamedSharding(mesh, P(axis))),
-        mesh, op, axis=axis, method=method, wire=wire))
+        mesh, op, axis=axis, method=method, wire=wire, groups=groups))
     np.testing.assert_allclose(got, want, atol=tol, rtol=1e-5 if not wire
                                else 5e-2)
 
 
-def sweep(world: int, sizes, smoke: bool) -> dict:
+def sweep(world: int, sizes, smoke: bool, ranks_per_host: int = 2) -> dict:
     import jax
 
     from rabit_tpu.ops.reducers import SUM
     from rabit_tpu.parallel.collectives import _swing_tables  # noqa: F401
     from rabit_tpu.parallel.dispatch import METHODS
+    from rabit_tpu.parallel import topology
     from rabit_tpu.utils.slope import slope_time
     from jax.sharding import Mesh
     import numpy as np
@@ -136,18 +144,30 @@ def sweep(world: int, sizes, smoke: bool) -> dict:
             f"need {world} devices, have {len(devs)} — set XLA_FLAGS="
             f"--xla_force_host_platform_device_count={world}")
     mesh = Mesh(np.array(devs[:world]), ("sweep",))
+    # forced grouping for the hier column: the virtual mesh has no real
+    # host boundary, so the sweep simulates ranks_per_host ranks per
+    # host — the same knob (rabit_hier_group=<g>) a deployment uses to
+    # override discovery. A non-hierarchical grouping drops the column.
+    groups = topology.parse_groups(str(ranks_per_host), world) \
+        if ranks_per_host > 1 else None
+    if not topology.is_hierarchical(groups, world):
+        groups = None
     k_small, k_big = (2, 4) if smoke else (2, 8)
     rows = []
     for dtype, op, section in (("float32", SUM, "float_sum"),
                                ("int32", SUM, "other")):
         for method in METHODS:
+            if method == "hier" and groups is None:
+                continue
+            g = groups if method == "hier" else None
             wires = (WIRES if section == "float_sum" and method != "tree"
                      else (None,))
             for wire in wires:
-                _check_correct(mesh, "sweep", method, wire, dtype, op)
+                _check_correct(mesh, "sweep", method, wire, dtype, op,
+                               groups=g)
                 for n in sizes:
                     run = _make_run(mesh, "sweep", n, dtype, op, method,
-                                    wire)
+                                    wire, groups=g)
                     s = slope_time(run, k_small, k_big,
                                    allow_noisy=smoke)
                     row = {"section": section, "method": method,
@@ -155,7 +175,9 @@ def sweep(world: int, sizes, smoke: bool) -> dict:
                     rows.append(row)
                     print(json.dumps(row), flush=True)
     return {"world": world, "backend": jax.default_backend(),
-            "k": [k_small, k_big], "rows": rows}
+            "k": [k_small, k_big],
+            "ranks_per_host": ranks_per_host if groups else 1,
+            "rows": rows}
 
 
 def derive_table(rows, sizes) -> dict:
@@ -182,8 +204,15 @@ def derive_table(rows, sizes) -> dict:
                     wire = w_best
             max_n = (None if i == len(sizes) - 1 else
                      int(math.sqrt(n * sizes[i + 1])))
-            out.append({"max_n": max_n, "method": best_method,
-                        "wire": wire})
+            row = {"max_n": max_n, "method": best_method, "wire": wire}
+            if best_method == "hier":
+                # the schedule auto-dispatch degrades to on a world
+                # whose grouping is not genuinely two-level — the best
+                # FLAT method at this size (dispatch._valid_rows)
+                row["flat"] = min(
+                    (m for (m, w) in cell if w is None and m != "hier"),
+                    key=lambda m: cell[(m, None)])
+            out.append(row)
         table[section] = out
     return table
 
@@ -193,6 +222,9 @@ def main() -> None:
     ap.add_argument("--smoke", action="store_true",
                     help="CI contract check: tiny size, noisy timing ok")
     ap.add_argument("--world", type=int, default=8)
+    ap.add_argument("--ranks-per-host", type=int, default=2,
+                    help="simulated ranks per host for the hier column "
+                         "(<=1 or non-divisor drops hier from the sweep)")
     ap.add_argument("--out", default=None,
                     help="artifact path (default: repo root, timestamped)")
     args = ap.parse_args()
@@ -203,7 +235,8 @@ def main() -> None:
     from rabit_tpu.parallel.dispatch import SCHEMA, load_table
 
     sizes = SMOKE_SIZES if args.smoke else FULL_SIZES
-    result = sweep(args.world, sizes, args.smoke)
+    result = sweep(args.world, sizes, args.smoke,
+                   ranks_per_host=args.ranks_per_host)
     result["schema"] = SCHEMA
     result["table"] = derive_table(result["rows"], sizes)
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(

@@ -66,9 +66,12 @@ SKIP_DIRS = {"build", "__pycache__", ".git", "native", ".eggs"}
 SPAN_REQUIRED = {
     os.path.join("rabit_tpu", "parallel", "collectives.py"): {
         "device_allreduce", "device_allreduce_tree", "device_broadcast",
-        "_per_shard_allreduce"},
+        "device_reduce_scatter", "device_allgather",
+        "device_hier_allreduce", "_per_shard_allreduce"},
+    os.path.join("rabit_tpu", "engine", "base.py"): {
+        "reduce_scatter", "allgather"},
     os.path.join("rabit_tpu", "engine", "xla.py"): {
-        "allreduce", "broadcast"},
+        "allreduce", "broadcast", "reduce_scatter", "allgather"},
     os.path.join("rabit_tpu", "engine", "native.py"): {
         "allreduce", "broadcast"},
     os.path.join("rabit_tpu", "engine", "dataplane.py"): {"_allreduce"},

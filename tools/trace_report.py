@@ -187,13 +187,17 @@ def render_skew(docs):
     comparable = [r for r in rounds if r["skew_s"] is not None]
     if not comparable:
         return None
-    rows = [(r["name"], r["round"], len(r["arrivals"]),
-             r["straggler_rank"], _fmt_s(r["skew_s"]),
+    # hierarchical allreduces stitch as one row PER PHASE (the three
+    # hier.* spans share a round id): the phase column turns "round 7
+    # straggled" into "round 7 straggled in the inter-host phase"
+    rows = [(r["name"], r["round"], r.get("phase") or "-",
+             len(r["arrivals"]), r["straggler_rank"], _fmt_s(r["skew_s"]),
              _fmt_s(r["critical_path_s"])) for r in comparable]
     out = (f"Cross-rank rounds ({len(comparable)} comparable of "
            f"{len(rounds)} stitched)\n\n" +
-           _md_table(("collective", "round", "ranks", "straggler",
-                      "arrival skew", "critical path"), rows))
+           _md_table(("collective", "round", "phase", "ranks",
+                      "straggler", "arrival skew", "critical path"),
+                     rows))
     attr = crossrank.skew_table(comparable)
     arow = [(a["rank"], a["rounds"], a["straggler_rounds"],
              _fmt_s(a["skew_caused_s"]), _fmt_s(a["worst_skew_s"]))
@@ -325,6 +329,20 @@ def smoke(out_dir):
                       for r in (1, 2)]}
     skew = render_skew([fdoc, peer])
     assert skew is not None and "Straggler: rank" in skew, skew
+    # hierarchical rounds stitch per phase: the three hier.* spans of
+    # one round must land as three critical-path rows with the phase
+    # column filled (the ISSUE-7 per-phase attribution contract)
+    phases = [("hier.reduce_scatter", "reduce_scatter"),
+              ("hier.inter", "inter"), ("hier.allgather", "allgather")]
+    hier = [{"rank": rk, "t_base_unix": 0.0,
+             "spans": [{"name": nm, "t0": 0.1 * i + 0.01 * rk,
+                        "dur": 1e-3, "attrs": {"round": 5, "phase": ph}}
+                       for i, (nm, ph) in enumerate(phases)]}
+            for rk in (0, 1)]
+    hskew = render_skew(hier)
+    assert hskew is not None, "hier phase rounds did not stitch"
+    for nm, ph in phases:
+        assert nm in hskew and ph in hskew, (nm, ph, hskew)
     telemetry.reset()
     print("telemetry smoke ok")
 
