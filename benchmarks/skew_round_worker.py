@@ -1,0 +1,103 @@
+"""Benchmark worker: allreduce rounds over a lagging fleet, flat vs
+skew-adapted (tools/skew_bench.py drives 4 of these over gloo).
+
+One designated rank sleeps ``LAG_MS`` before every collective — the
+persistent arrival straggler arXiv:1804.05349 measures. Both series run
+in-process on the same fabric: first with ``rabit_skew_adapt`` off
+(every rank pays the lag inside the flat ring), then with it on and a
+forced digest naming the laggard (pre-aggregation overlaps the early
+ranks' reduction with the laggard's delay). Per-round cost is the
+fleet MAX of the per-rank in-call time (the round completes when the
+slowest view does); rank 0 prints ONE JSON line with the two means
+(warmup rounds excluded).
+
+argv: <process_id> <num_processes> <coordinator_port>
+env: PAYLOAD (default 2000000 float32 elems), LAG_MS (80),
+     LAG_RANK (2), N_ROUNDS (6), N_WARMUP (2)
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+from rabit_tpu.telemetry import skew  # noqa: E402
+
+
+def _set_adapt(enabled: bool, world: int, lag_rank: int,
+               lag_ms: float) -> None:
+    if enabled:
+        os.environ["RABIT_SKEW_ADAPT"] = "1"
+        os.environ["RABIT_SKEW_PREAGG_MS"] = "0.0001"
+        os.environ["RABIT_SKEW_DIGEST"] = json.dumps(
+            {"epoch": 1, "laggard": lag_rank,
+             "offsets_ms": {str(i): (lag_ms if i == lag_rank else 0.0)
+                            for i in range(world)}})
+    else:
+        for var in ("RABIT_SKEW_ADAPT", "RABIT_SKEW_PREAGG_MS",
+                    "RABIT_SKEW_DIGEST"):
+            os.environ.pop(var, None)
+    skew.reset_monitor()
+
+
+def _timed_rounds(xs: np.ndarray, rank: int, lag_rank: int, lag_s: float,
+                  rounds: int, warmup: int) -> float:
+    times = []
+    for i in range(warmup + rounds):
+        rabit.allreduce(np.zeros(1, np.int32), rabit.SUM)  # align start
+        if rank == lag_rank:
+            time.sleep(lag_s)
+        t0 = time.perf_counter()
+        out = rabit.allreduce(xs, rabit.SUM)
+        dt = time.perf_counter() - t0
+        assert out.shape == xs.shape
+        if i >= warmup:
+            times.append(float(rabit.allreduce(
+                np.array([dt], np.float64), rabit.MAX)[0]))
+    return sum(times) / len(times)
+
+
+def main() -> None:
+    pid, nproc, port = sys.argv[1], sys.argv[2], sys.argv[3]
+    rabit.init(["rabit_engine=xla",
+                f"rabit_coordinator=127.0.0.1:{port}",
+                f"rabit_num_processes={nproc}",
+                f"rabit_process_id={pid}"])
+    rank, world = rabit.get_rank(), rabit.get_world_size()
+
+    payload = int(os.environ.get("PAYLOAD", "2000000"))
+    lag_ms = float(os.environ.get("LAG_MS", "80"))
+    lag_rank = int(os.environ.get("LAG_RANK", "2")) % world
+    rounds = int(os.environ.get("N_ROUNDS", "6"))
+    warmup = int(os.environ.get("N_WARMUP", "2"))
+
+    xs = (np.arange(payload) % 251).astype(np.float32) + rank
+    _set_adapt(False, world, lag_rank, lag_ms)
+    flat_ms = _timed_rounds(xs, rank, lag_rank, lag_ms / 1e3,
+                            rounds, warmup) * 1e3
+    _set_adapt(True, world, lag_rank, lag_ms)
+    adapted_ms = _timed_rounds(xs, rank, lag_rank, lag_ms / 1e3,
+                               rounds, warmup) * 1e3
+    _set_adapt(False, world, lag_rank, lag_ms)
+
+    if rank == 0:
+        print(json.dumps({
+            "world": world, "payload_elems": payload, "dtype": "float32",
+            "lag_rank": lag_rank, "lag_ms": lag_ms, "rounds": rounds,
+            "skew_round_ms_flat": round(flat_ms, 3),
+            "skew_round_ms_adapted": round(adapted_ms, 3)}), flush=True)
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

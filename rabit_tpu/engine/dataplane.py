@@ -142,6 +142,14 @@ class XlaDataPlane:
                 f"rabit_reduce_method must be one of "
                 f"{('auto',) + METHODS}, got {method!r}")
         self._method = method
+        # skew-adaptation knobs (rabit_skew_adapt / rabit_skew_preagg_ms
+        # / rabit_skew_poll_ms): validated at init for the same reason as
+        # the wire — a garbage value must fail loudly here, not silently
+        # disable adaptation mid-training. The knobs themselves are read
+        # live by telemetry/skew.py on each dispatch.
+        from ..telemetry import skew as _skewmod
+        _skewmod.preagg_ms_per_mib()   # raises ValueError on garbage
+        _skewmod.poll_interval_s()     # raises ValueError on garbage
         # keep the ctypes callback object alive for the C side
         self.c_callback = DATAPLANE_CB(self._invoke)
 
@@ -363,6 +371,13 @@ class XlaDataPlane:
                 # per-call wire= in the collectives API still forces it)
                 out = device_allreduce(xs, mesh, op, axis="proc",
                                        method=self._method, wire="auto")
+            if sp.live:
+                # label adapted rounds for cross-rank stitching (same
+                # contract as the xla engine span)
+                from ..telemetry import skew as _skewmod
+                tag = _skewmod.last_applied()
+                if tag:
+                    sp.attrs["adapted"] = tag
             res = np.asarray(out.addressable_data(0)).reshape(-1)
         if res.dtype != buf.dtype:
             raise TypeError(

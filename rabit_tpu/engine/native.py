@@ -244,6 +244,7 @@ class NativeEngine(Engine):
             self._export_env("RABIT_REDUCE_METHOD",
                              cfg.get("rabit_reduce_method", ""))
             self._export_hier_topology(cfg)
+            self._export_skew(cfg)
             self._dataplane = XlaDataPlane(
                 self._lib,
                 init_timeout=cfg.get_int("rabit_dataplane_init_timeout", 60))
@@ -278,6 +279,25 @@ class NativeEngine(Engine):
                         groups, self.world_size):
                     group = topology.groups_spec(groups)
         self._export_env("RABIT_HIER_GROUP", group)
+
+    def _export_skew(self, cfg) -> None:
+        """Skew-adaptation knobs -> env for the XLA data plane, plus the
+        tracker address (``RABIT_SKEW_TRACKER``) so the worker-side
+        :class:`telemetry.skew.SkewMonitor` can pull the fleet digest
+        (the ``skew`` wire command) lazily from the dispatch path. Only
+        exported when adaptation is requested — with the knob unset no
+        skew env exists and the dispatch path never consults the
+        module."""
+        self._export_env("RABIT_SKEW_ADAPT", cfg.get("rabit_skew_adapt", ""))
+        self._export_env("RABIT_SKEW_PREAGG_MS",
+                         cfg.get("rabit_skew_preagg_ms", ""))
+        self._export_env("RABIT_SKEW_POLL_MS",
+                         cfg.get("rabit_skew_poll_ms", ""))
+        if cfg.get_bool("rabit_skew_adapt"):
+            host = cfg.get("rabit_tracker_uri")
+            port = cfg.get_int("rabit_tracker_port", 0)
+            if host and port:
+                self._export_env("RABIT_SKEW_TRACKER", f"{host}:{port}")
 
     def _start_live_plane(self, cfg) -> None:
         """Live observability: per-rank metrics endpoint, off unless

@@ -254,6 +254,14 @@ class XlaEngine(Engine):
             else:
                 out = device_allreduce(xs, mesh, op, axis="proc",
                                        method=method, wire=wire)
+            if sp.live:
+                # round-carrying span learns which adaptation the device
+                # layer applied (if any) so cross-rank stitching can
+                # label adapted rounds (telemetry/skew.py)
+                from ..telemetry import skew as _skewmod
+                tag = _skewmod.last_applied()
+                if tag:
+                    sp.attrs["adapted"] = tag
             res = np.asarray(out.addressable_data(0)).reshape(-1)
         if res.dtype != buf.dtype:
             raise TypeError(

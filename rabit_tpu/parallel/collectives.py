@@ -62,6 +62,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import telemetry
 from ..telemetry import profile as _profile
+from ..telemetry import skew as _skew
 from ..ops.reducers import SUM, MAX, MIN, BITOR, OP_NAMES, jax_reduce_fn
 from . import topology as _topology
 from .dispatch import (RING_MINCOUNT_DEFAULT,  # noqa: F401  (re-export)
@@ -376,7 +377,8 @@ def ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
 
 
 def bidir_ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
-                         wire: str | None = None) -> jax.Array:
+                         wire: str | None = None,
+                         groups=None) -> jax.Array:
     """Bidirectional ring allreduce: the payload splits in half and the
     two halves run counter-rotating rings (forward and reverse ppermute
     schedules) that XLA overlaps — on a 1-D mesh whose links are
@@ -385,9 +387,11 @@ def bidir_ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     hop instead of n/p).
 
     Same contract as :func:`ring_allreduce` (1-D per-shard input,
-    ``wire`` on float SUM). Payloads too small to split (< 2p elements)
-    run a single forward ring — at that size the split only adds
-    latency."""
+    ``wire`` on float SUM, ``groups`` sub-rings — both counter-rotating
+    halves follow the same grouped order, so a skew-adaptive rotation
+    applies to both directions). Payloads too small to split (< 2p
+    elements) run a single forward ring — at that size the split only
+    adds latency."""
     if x.ndim != 1:
         raise ValueError(
             f"bidir_ring_allreduce takes a 1-D per-shard array, got "
@@ -397,10 +401,11 @@ def bidir_ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     if p == 1:
         return x
     if n < 2 * p:
-        return ring_allreduce(x, axis_name, op, wire=wire)
+        return ring_allreduce(x, axis_name, op, wire=wire, groups=groups)
     half = n - n // 2
-    lo = ring_allreduce(x[:half], axis_name, op, wire=wire)
-    hi = ring_allreduce(x[half:], axis_name, op, wire=wire, reverse=True)
+    lo = ring_allreduce(x[:half], axis_name, op, wire=wire, groups=groups)
+    hi = ring_allreduce(x[half:], axis_name, op, wire=wire, reverse=True,
+                        groups=groups)
     return jnp.concatenate([lo, hi])
 
 
@@ -683,6 +688,83 @@ def tree_allreduce(x: jax.Array, axis_name: str, op: int = SUM) -> jax.Array:
     raise ValueError(f"unknown op {op}")
 
 
+def preagg_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
+                     groups=None) -> jax.Array:
+    """Pre-aggregating allreduce for a world with a known laggard — the
+    arXiv:1804.05349 core idea rendered in static SPMD form.
+
+    ``groups`` is ``((early...), (laggard,))``
+    (``telemetry.skew.preagg_groups``): the measured arrival order is a
+    static schedule input, not a runtime discovery — SPMD programs
+    cannot change membership mid-flight, but they CAN order the
+    dependency graph so nothing waits on the laggard until its
+    contribution is genuinely needed.
+
+    1. the arrived subgroup reduces among itself (grouped
+       psum/pmax/pmin; the laggard sits in a singleton group and
+       exchanges nothing — on an async fabric this phase completes
+       while the laggard is still on its way);
+    2. on arrival, one full-duplex ppermute exchange at the fold root:
+       the laggard's raw vector goes out, the subgroup result comes
+       back;
+    3. the laggard's vector binomially doubles to the remaining ranks
+       and every rank folds locally.
+
+    Total post-arrival work is one exchange plus ceil(log2(p-1))
+    doubling hops of n bytes — against the full reduction a flat
+    schedule would only START at arrival. The extra fold traffic is why
+    dispatch gates this behind a measured per-MiB skew threshold
+    (``rabit_skew_preagg_ms``). SUM/MAX/MIN only; the wire codec never
+    applies (raw ppermute payloads). All ranks end bit-identical: each
+    value is produced once and copied."""
+    if x.ndim != 1:
+        raise ValueError(
+            f"preagg_allreduce takes a 1-D per-shard array, got shape "
+            f"{x.shape}; flatten first")
+    p = axis_size(axis_name)
+    if p == 1:
+        return x
+    if (not groups or len(groups) != 2 or len(groups[1]) != 1
+            or sorted(groups[0] + groups[1]) != list(range(p))):
+        raise ValueError(
+            f"preagg groups must be ((early...), (laggard,)) covering "
+            f"ranks 0..{p - 1}, got {groups!r}")
+    if op not in (SUM, MAX, MIN):
+        raise ValueError(
+            f"preagg_allreduce supports SUM/MAX/MIN, got op {op}")
+    early, laggard = tuple(groups[0]), groups[1][0]
+    root = early[0]
+    grouped = {SUM: lax.psum, MAX: lax.pmax, MIN: lax.pmin}[op]
+    combine = {SUM: jnp.add, MAX: jnp.maximum, MIN: jnp.minimum}[op]
+    with telemetry.trace_annotation("rabit_preagg_allreduce"):
+        # phase 1: subgroup reduction (the laggard's singleton group
+        # reduces to its own contribution — no wire, no wait)
+        partial = grouped(x, axis_name,
+                          axis_index_groups=[list(early), [laggard]])
+        idx = lax.axis_index(axis_name)
+        # phase 2: full-duplex exchange at the fold root
+        recv = lax.ppermute(partial, axis_name,
+                            perm=[(laggard, root), (root, laggard)])
+        sub = jnp.where(idx == laggard, recv, partial)
+        lag_vec = jnp.where(
+            (idx == laggard) | (idx == root),
+            jnp.where(idx == root, recv, partial), jnp.zeros_like(x))
+        # phase 3: binomial doubling of the laggard's vector from
+        # {laggard, root} until every rank holds it, then a local fold
+        holders = [laggard, root]
+        others = [r for r in early[1:]]
+        while others:
+            pairs = list(zip(holders, others))
+            sent = lax.ppermute(lag_vec, axis_name, perm=pairs)
+            newly = [d for (_, d) in pairs]
+            mask = functools.reduce(jnp.logical_or,
+                                    [idx == d for d in newly])
+            lag_vec = jnp.where(mask, sent, lag_vec)
+            holders = holders + newly
+            others = others[len(pairs):]
+        return combine(sub, lag_vec)
+
+
 def psum_identity_grad(x: jax.Array, axis_name: str) -> jax.Array:
     """``lax.psum`` whose backward pass is the identity — for
     ``check_vma=False`` (unchecked) shard_map contexts ONLY.
@@ -770,7 +852,9 @@ def _per_shard_allreduce(flat, axis: str, op: int, method: str,
             return tree_allreduce(flat, axis, op)
         if method == "hier":
             return hier_allreduce(flat, axis, op, groups=groups, wire=wire)
-        return _METHOD_FNS[method](flat, axis, op, wire=wire)
+        if method == "preagg":
+            return preagg_allreduce(flat, axis, op, groups=groups)
+        return _METHOD_FNS[method](flat, axis, op, wire=wire, groups=groups)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "method",
@@ -831,17 +915,34 @@ def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
     method, wire = _dispatch_resolve(n, xs.dtype, op, mesh.shape[axis],
                                      method=method, wire=wire,
                                      groups=groups)
-    if method != "hier":
+    if method not in ("hier", "preagg"):
         groups = None  # flat schedules ignore topology: keep the jit
         #                cache key stable across grouping changes
+    adapted = None
+    if _skew.adapt_enabled():
+        # skew adaptation only permutes the schedule (rotation groups /
+        # preagg fold order are static jit args); arithmetic per rank
+        # pair is unchanged, so the replay contract holds
+        plan = _skew.adapt_plan(method, mesh.shape[axis],
+                                n * xs.dtype.itemsize,
+                                OP_NAMES.get(op, str(op)), groups=groups,
+                                digest=_skew.monitor().current())
+        if plan is not None:
+            method, groups = plan["method"], plan["groups"]
+            if method == "preagg":
+                wire = None  # raw ppermute payloads, codec never applies
+            adapted = f"{plan['kind']}@{plan['laggard']}"
+        _skew.note_applied(adapted)
     cost = _profile.record_cost(
         "allreduce", method, wire, n, xs.dtype.itemsize, mesh.shape[axis],
         group_size=len(groups[0]) if groups else None)
     extra = ({"cost_flops": cost["flops"],
               "cost_wire_bytes": cost["wire_bytes"],
               "cost_hops": cost["hops"]} if cost else {})
-    if groups:
+    if method == "hier" and groups:
         extra["hosts"] = len(groups)
+    if adapted:
+        extra["adapted"] = adapted
     sp = telemetry.span("allreduce", nbytes=n * xs.dtype.itemsize,
                         op=OP_NAMES.get(op, str(op)), method=method,
                         wire=wire, **extra)
@@ -1013,6 +1114,19 @@ def device_hier_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
         flat = "swing" if inter_method == "swing" else "ring"
         return device_allreduce(xs, mesh, op=op, axis=axis, method=flat,
                                 wire=wire or "none")
+    adapted = None
+    if _skew.adapt_enabled():
+        # demote a lagging delegate to the tail of its host group: slot 0
+        # (the inter-host delegate ring) moves to the earliest co-hosted
+        # rank, the laggard only participates intra-host
+        plan = _skew.adapt_plan("hier", p, int(np.prod(xs.shape[1:]))
+                                * xs.dtype.itemsize,
+                                OP_NAMES.get(op, str(op)), groups=groups,
+                                digest=_skew.monitor().current())
+        if plan is not None:
+            groups = plan["groups"]
+            adapted = f"{plan['kind']}@{plan['laggard']}"
+        _skew.note_applied(adapted)
     g, hosts = len(groups[0]), len(groups)
     slots = _topology.slot_rings(groups)
     shape = xs.shape[1:]
@@ -1034,6 +1148,8 @@ def device_hier_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
         extra = ({"cost_flops": cost["flops"],
                   "cost_wire_bytes": cost["wire_bytes"],
                   "cost_hops": cost["hops"]} if cost else {})
+        if adapted:
+            extra["adapted"] = adapted
         sp = telemetry.span(name, nbytes=nbytes, op=opname, method=method,
                             wire=w, round=rnd, phase=phase, hosts=hosts,
                             group_size=g, **extra)

@@ -38,8 +38,16 @@ WIRE_MINCOUNT_DEFAULT = 256 << 10
 
 METHODS = ("tree", "ring", "bidir", "swing", "hier")
 
+# "preagg" is a valid EXPLICIT method (and what skew adaptation elects)
+# but never a table row: sweeps measure steady-state schedules, and
+# pre-aggregation only exists relative to a measured laggard.
+EXPLICIT_METHODS = METHODS + ("preagg",)
+
 SCHEMA_PREFIX = "rabit_tpu.collective_sweep/"
-SCHEMA = SCHEMA_PREFIX + "v1"
+# v2 adds the skew/lag columns (tools/collective_sweep.py --lag-rank);
+# v1 artifacts are committed history and must keep loading.
+SCHEMA = SCHEMA_PREFIX + "v2"
+ACCEPTED_SCHEMAS = (SCHEMA, SCHEMA_PREFIX + "v1")
 
 _TABLE_ENV = "RABIT_DISPATCH_TABLE"
 _WIRE_ENV = "RABIT_DATAPLANE_WIRE"
@@ -108,10 +116,10 @@ def load_table(path: Optional[str] = None) -> Optional[dict]:
     Resolution order: explicit ``path`` arg, ``RABIT_DISPATCH_TABLE``
     env (``none``/``off``/``0`` disables), newest
     ``COLLECTIVE_SWEEP_*.json`` under ``benchmarks/artifacts/`` (repo
-    root also scanned for compatibility). A missing file, a
-    schema other than exactly ``rabit_tpu.collective_sweep/v1`` (future
-    majors must not be misread), or malformed rows all yield None —
-    dispatch must degrade to the documented defaults, never crash.
+    root also scanned for compatibility). A missing file, a schema
+    outside ``ACCEPTED_SCHEMAS`` (v2 and the legacy v1 — future majors
+    must not be misread), or malformed rows all yield None — dispatch
+    must degrade to the documented defaults, never crash.
     """
     if path is None:
         env = os.environ.get(_TABLE_ENV)
@@ -134,7 +142,7 @@ def load_table(path: Optional[str] = None) -> Optional[dict]:
     try:
         with open(path) as f:
             data = json.load(f)
-        if data.get("schema") == SCHEMA:
+        if data.get("schema") in ACCEPTED_SCHEMAS:
             cand = data.get("table")
             if (isinstance(cand, dict)
                     and _valid_rows(cand.get("float_sum"))
@@ -171,6 +179,13 @@ def resolve(n: int, dtype, op: int, axis_size: int,
     An EXPLICIT ``method="hier"`` on such a world degrades to ``ring``,
     the same degradation contract as swing on a non-power-of-two world.
 
+    With ``rabit_skew_adapt`` on and a live digest naming a laggard,
+    auto additionally prefers skew-tolerant shapes (swing/bidir →
+    tree/ring by size) and stamps provenance ``skew_adapted`` plus the
+    ``dispatch.skew_adapted`` counter; the concrete re-root / rotation /
+    pre-aggregation plan is applied by ``device_allreduce``
+    (``telemetry/skew.py``).
+
     ``wire="auto"``: engages the ``RABIT_DATAPLANE_WIRE`` env wire (the
     ``rabit_dataplane_wire`` config export) only where measurement says
     it pays — the table bucket's wire field, else ``n >=
@@ -185,6 +200,7 @@ def resolve(n: int, dtype, op: int, axis_size: int,
     import jax.numpy as jnp
 
     from ..ops.reducers import BITOR, SUM, OP_NAMES
+    from ..telemetry import skew
     from . import topology
     requested = method
     table = load_table()
@@ -204,17 +220,30 @@ def resolve(n: int, dtype, op: int, axis_size: int,
             method = "ring" if n >= RING_MINCOUNT_DEFAULT else "tree"
         if op == BITOR and n >= 1024 and method == "tree":
             method = "ring"  # tree BitOR all-gathers: tiny buffers only
-    if method not in METHODS:
-        raise ValueError(f"method must be one of {('auto',) + METHODS}, "
-                         f"got {method!r}")
+    if method not in EXPLICIT_METHODS:
+        raise ValueError(
+            f"method must be one of {('auto',) + EXPLICIT_METHODS}, "
+            f"got {method!r}")
     if method == "hier" and not hier_ok:
         method = "ring"  # no usable host grouping: flat ring IS the
         #                  inter-host path (degradation contract)
     if method == "swing" and axis_size & (axis_size - 1):
         method = "ring"  # swing needs a power-of-two world
+    adapted = False
+    if requested == "auto" and skew.adapt_enabled():
+        # live skew consult: with a digest naming a persistent laggard,
+        # prefer skew-tolerant shapes — the fixed-topology involutions
+        # (swing, bidir) have no good place to park a laggard, while
+        # tree re-roots and ring rotates (collectives apply the actual
+        # plan; here only the method family is elected)
+        if skew.laggard_of(skew.monitor().current()) is not None:
+            adapted = True
+            if method in ("swing", "bidir"):
+                method = ("tree" if n < RING_MINCOUNT_DEFAULT else "ring")
     if wire == "auto":
         env_wire = os.environ.get(_WIRE_ENV) or None
-        if env_wire is None or method == "tree" or not wire_eligible:
+        if (env_wire is None or method in ("tree", "preagg")
+                or not wire_eligible):
             wire = None
         elif table is not None and not os.environ.get(_WIRE_MINCOUNT_ENV):
             wire = env_wire if _bucket(table["float_sum"], n).get("wire") \
@@ -226,7 +255,10 @@ def resolve(n: int, dtype, op: int, axis_size: int,
     from .. import telemetry
     if telemetry.enabled():
         provenance = ("explicit" if requested != "auto"
+                      else "skew_adapted" if adapted
                       else "table" if table is not None else "fallback")
+        if adapted:
+            telemetry.count("dispatch.skew_adapted")
         telemetry.record_dispatch(
             n, jnp.dtype(dtype).itemsize, OP_NAMES.get(op, str(op)),
             method, wire, provenance)

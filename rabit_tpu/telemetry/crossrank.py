@@ -46,6 +46,7 @@ def _records_from_spans(spans: Iterable[dict],
             continue
         out.append({"round": int(rnd), "name": s["name"],
                     "phase": attrs.get("phase"),
+                    "adapted": attrs.get("adapted"),
                     "t_wall": t_base_unix + float(s.get("t0", 0.0)),
                     "dur": float(s.get("dur", 0.0))})
     return out
@@ -63,6 +64,7 @@ def _records_from_trace(doc: dict) -> List[dict]:
             continue
         out.append({"round": int(rnd), "name": ev["name"],
                     "phase": args.get("phase"),
+                    "adapted": args.get("adapted"),
                     "t_wall": base + float(ev.get("ts", 0.0)) / 1e6,
                     "dur": float(ev.get("dur", 0.0)) / 1e6})
     return out
@@ -101,9 +103,12 @@ def stitch_rounds(per_rank: Dict[int, List[dict]]) -> List[dict]:
             row = rounds.setdefault(key, {"name": r["name"],
                                           "round": r["round"],
                                           "phase": r.get("phase"),
+                                          "adapted": r.get("adapted"),
                                           "arrivals": {}, "durs": {}})
             if row.get("phase") is None:
                 row["phase"] = r.get("phase")
+            if row.get("adapted") is None:
+                row["adapted"] = r.get("adapted")
             row["arrivals"][rank] = r["t_wall"]
             row["durs"][rank] = r["dur"]
     out = []

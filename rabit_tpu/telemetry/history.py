@@ -112,7 +112,8 @@ def extract_metrics(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
     for key in ("best_step_s", "compile_plus_first_step_s"):
         if key in doc:
             add(key, doc.get(key), "s")
-    if doc.get("schema") == "rabit_tpu.collective_sweep/v1" \
+    if doc.get("schema") in ("rabit_tpu.collective_sweep/v1",
+                             "rabit_tpu.collective_sweep/v2") \
             and not doc.get("smoke"):  # smoke timings are noise by design
         # one series per (section, method, wire, size): the sentinel
         # then trends every schedule's s_per_op across committed sweeps

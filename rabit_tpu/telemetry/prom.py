@@ -71,6 +71,8 @@ METRIC_FAMILIES = (
     "rabit_tracker_topology_ranks_per_host",
     "rabit_straggler_lag_collectives",
     "rabit_straggler_busy_skew_seconds",
+    "rabit_skew_offset_ms",
+    "rabit_skew_epoch",
 )
 
 

@@ -1,0 +1,486 @@
+"""Live arrival-skew estimation and schedule-adaptation policy.
+
+The observability plane measures per-round arrival skew and names the
+laggard rank (``crossrank.straggler_snapshot``, tracker ``/straggler``)
+but until this module nothing fed the measurement back into dispatch:
+every schedule assumed ranks arrive together, which arXiv:1804.05349
+shows leaves large fractions of round time on the table under
+imbalanced process arrival.
+
+Three pieces live here, all plain Python (no jax import — the tracker
+uses the estimator and the digest builder without an accelerator
+stack):
+
+- :class:`SkewEstimator` — an EWMA of per-rank arrival offsets with
+  hysteresis on the laggard election, so one noisy round cannot flip
+  the adapted schedule (and with it the jit cache key) back and forth;
+- the fleet **skew digest** ``{epoch, offsets_ms, laggard}`` — built
+  tracker-side from the ``/straggler`` poll sweep
+  (:func:`digest_from_snapshot`), served over the ``skew`` wire command
+  (mirroring ``topo``), fetched worker-side by :func:`fetch_skew`, and
+  cached/refreshed by the process-global :class:`SkewMonitor`;
+- the pure **adaptation plan** (:func:`adapt_plan` and its helpers) —
+  given a method, world size, and digest, decide the re-rooted /
+  rotated / pre-aggregating schedule. Pure functions on ints, so the
+  permutation property tests run without a mesh.
+
+Everything is off by default behind ``rabit_skew_adapt``; with the
+knob unset no caller consults this module on the jit path at all.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+_ADAPT_ENV = "RABIT_SKEW_ADAPT"
+_PREAGG_ENV = "RABIT_SKEW_PREAGG_MS"
+_POLL_ENV = "RABIT_SKEW_POLL_MS"
+_DIGEST_ENV = "RABIT_SKEW_DIGEST"
+_TRACKER_ENV = "RABIT_SKEW_TRACKER"
+
+_ON = ("1", "true", "yes", "on")
+
+# Pre-aggregation pays for its extra fold traffic only when the hidden
+# wait exceeds the transfer time it adds; 2 ms per MiB of payload is
+# conservative against loopback TCP (~GB/s) and far below any real
+# cross-host straggler this repo has measured (BUSY_SKEW_SIGNAL_S = 1s).
+PREAGG_MS_PER_MIB_DEFAULT = 2.0
+
+# Digest refresh cadence (worker-side pull of the tracker's `skew`
+# command). Floored like the metrics poll: sub-100ms polling would put
+# socket latency on the dispatch path.
+POLL_MS_DEFAULT = 2000
+POLL_MS_FLOOR = 100
+
+# EWMA smoothing and laggard-flip hysteresis defaults. A challenger
+# must beat the incumbent laggard's smoothed offset by HYSTERESIS_MS
+# before the election flips — each flip changes a static jit argument,
+# so flapping costs recompiles, not just wrong rotations.
+EWMA_ALPHA = 0.3
+HYSTERESIS_MS = 5.0
+
+
+def adapt_enabled() -> bool:
+    """Whether skew adaptation may engage (``rabit_skew_adapt``,
+    exported as ``RABIT_SKEW_ADAPT``; default off). Enabled alone does
+    nothing — a digest naming a laggard must also be live."""
+    return os.environ.get(_ADAPT_ENV, "").strip().lower() in _ON
+
+
+def preagg_ms_per_mib() -> float:
+    """Per-MiB skew threshold (ms) above which pre-aggregation engages
+    (``rabit_skew_preagg_ms``); ``<= 0`` disables pre-aggregation while
+    keeping rotation/re-rooting."""
+    v = os.environ.get(_PREAGG_ENV)
+    if not v:
+        return PREAGG_MS_PER_MIB_DEFAULT
+    try:
+        return float(v)
+    except ValueError:
+        raise ValueError(
+            f"{_PREAGG_ENV} must be a number (ms per MiB), got {v!r}")
+
+
+def poll_interval_s() -> float:
+    """Worker-side digest refresh interval in seconds
+    (``rabit_skew_poll_ms``, floor {POLL_MS_FLOOR} ms)."""
+    v = os.environ.get(_POLL_ENV)
+    if not v:
+        return POLL_MS_DEFAULT / 1000.0
+    try:
+        ms = int(v)
+    except ValueError:
+        raise ValueError(
+            f"{_POLL_ENV} must be an integer (ms), got {v!r}")
+    return max(ms, POLL_MS_FLOOR) / 1000.0
+
+
+# --------------------------------------------------------------- estimator
+
+
+class SkewEstimator:
+    """EWMA of per-rank arrival offsets with a hysteretic laggard.
+
+    ``update`` folds one observation (a ``{rank: offset_ms}`` map —
+    one poll sweep's fleet view, or one stitched round's arrivals) into
+    the smoothed state. The laggard only flips when a challenger's
+    smoothed offset exceeds the incumbent's by ``hysteresis_ms``: the
+    elected laggard becomes a static jit argument downstream, so the
+    election must be stable under round-to-round noise."""
+
+    def __init__(self, alpha: float = EWMA_ALPHA,
+                 hysteresis_ms: float = HYSTERESIS_MS):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = float(alpha)
+        self.hysteresis_ms = float(hysteresis_ms)
+        self._ewma: Dict[int, float] = {}
+        self._laggard: Optional[int] = None
+
+    def update(self, offsets_ms: Dict[int, float]) -> None:
+        a = self.alpha
+        for rank, off in offsets_ms.items():
+            rank, off = int(rank), float(off)
+            prev = self._ewma.get(rank)
+            self._ewma[rank] = off if prev is None else \
+                a * off + (1.0 - a) * prev
+        if not self._ewma:
+            return
+        challenger = max(self._ewma, key=self._ewma.get)
+        if self._laggard is None or self._laggard not in self._ewma:
+            self._laggard = challenger
+        elif challenger != self._laggard:
+            if self._ewma[challenger] > (self._ewma[self._laggard]
+                                         + self.hysteresis_ms):
+                self._laggard = challenger
+
+    @property
+    def laggard(self) -> Optional[int]:
+        return self._laggard
+
+    def offsets_ms(self) -> Dict[int, float]:
+        return dict(self._ewma)
+
+    def skew_ms(self) -> float:
+        """Smoothed spread between the latest and earliest rank."""
+        if len(self._ewma) < 2:
+            return 0.0
+        vals = self._ewma.values()
+        return max(vals) - min(vals)
+
+
+# ----------------------------------------------------------------- digest
+
+
+def digest_from_snapshot(snap: dict, epoch: int = 0) -> Optional[dict]:
+    """Tracker-side: one ``/straggler`` snapshot -> the compact skew
+    digest the ``skew`` wire command serves.
+
+    Offsets come from the counter heuristic's busy times: the rank the
+    fleet waits FOR spends the least time inside collectives, so its
+    estimated per-round arrival offset is ``(max busy - busy) /
+    collectives``. ``laggard`` carries the snapshot's verdict verbatim —
+    None on a tie (``signal=false``): a digest must never accuse a
+    candidate the detector itself declined to name."""
+    rows = [r for r in (snap or {}).get("ranks", [])
+            if isinstance(r, dict) and r.get("rank") is not None]
+    if not rows:
+        return None
+    busiest = max(float(r.get("busy_s", 0.0)) for r in rows)
+    offsets = {}
+    for r in rows:
+        per_round = (busiest - float(r.get("busy_s", 0.0))) \
+            / max(1, int(r.get("collectives", 0)))
+        offsets[str(int(r["rank"]))] = round(per_round * 1e3, 3)
+    laggard = snap.get("lagging_rank") if snap.get("signal") else None
+    return {"epoch": int(epoch), "offsets_ms": offsets,
+            "laggard": None if laggard is None else int(laggard)}
+
+
+def parse_digest(doc) -> Optional[dict]:
+    """Validate a wire/env digest into canonical int-keyed form, or
+    None — a malformed digest disables adaptation rather than crashing
+    the dispatch path."""
+    if not isinstance(doc, dict):
+        return None
+    raw = doc.get("offsets_ms")
+    if not isinstance(raw, dict):
+        return None
+    try:
+        offsets = {int(k): float(v) for k, v in raw.items()}
+        epoch = int(doc.get("epoch", 0))
+        laggard = doc.get("laggard")
+        laggard = None if laggard is None else int(laggard)
+    except (TypeError, ValueError):
+        return None
+    if laggard is not None and laggard not in offsets:
+        return None
+    return {"epoch": epoch, "offsets_ms": offsets, "laggard": laggard}
+
+
+def fetch_skew(host: str, port: int, task_id: str = "0",
+               timeout: float = 5.0) -> Optional[dict]:
+    """Pull the tracker's current skew digest (``skew`` wire command,
+    same rendezvous protocol as ``topo``). Best-effort: returns None
+    instead of raising — a tracker that predates the command, went
+    away, or has no digest yet just means no adaptation."""
+    from ..tracker.tracker import MAGIC, _recv_str, _send_str, _send_u32
+    from ..utils import retry
+    try:
+        with retry.connect_with_retry(
+                host, int(port), timeout=timeout,
+                deadline=retry.Deadline(timeout)) as conn:
+            _send_u32(conn, MAGIC)
+            _send_str(conn, "skew")
+            _send_str(conn, task_id)
+            _send_u32(conn, 0)  # num_attempt (informational)
+            doc = json.loads(_recv_str(conn))
+        return parse_digest(doc)
+    except (OSError, ValueError, ConnectionError, retry.RetryError):
+        return None
+
+
+class SkewMonitor:
+    """Process-global cache of the live fleet skew view.
+
+    Sources, strongest first: a forced ``RABIT_SKEW_DIGEST`` env digest
+    (tests, CI smoke — deterministic, no tracker needed), then the
+    tracker's ``skew`` command via ``RABIT_SKEW_TRACKER=host:port``
+    (exported by the engine at init), refreshed lazily at most every
+    ``rabit_skew_poll_ms``. Observations feed the EWMA estimator, whose
+    hysteretic laggard — not the raw digest's — drives adaptation."""
+
+    def __init__(self):
+        self._est = SkewEstimator()
+        self._digest: Optional[dict] = None
+        self._forced_raw: Optional[str] = None
+        self._next_fetch = 0.0
+
+    def observe(self, doc) -> Optional[dict]:
+        """Fold one digest into the smoothed view; returns the current
+        (smoothed) digest."""
+        d = parse_digest(doc)
+        if d is not None:
+            self._est.update(d["offsets_ms"])
+            self._digest = {"epoch": d["epoch"],
+                            "offsets_ms": self._est.offsets_ms(),
+                            "laggard": (self._est.laggard
+                                        if d["laggard"] is not None
+                                        else None)}
+        return self._digest
+
+    def current(self) -> Optional[dict]:
+        forced = os.environ.get(_DIGEST_ENV)
+        if forced:
+            if forced != self._forced_raw:
+                self._forced_raw = forced
+                try:
+                    self.observe(json.loads(forced))
+                except ValueError:
+                    self._digest = None
+            return self._digest
+        self._forced_raw = None
+        addr = os.environ.get(_TRACKER_ENV, "")
+        if ":" in addr:
+            now = time.monotonic()
+            if now >= self._next_fetch:
+                self._next_fetch = now + poll_interval_s()
+                host, _, port = addr.rpartition(":")
+                try:
+                    d = fetch_skew(host, int(port))
+                except ValueError:
+                    d = None
+                if d is not None:
+                    self.observe(d)
+        return self._digest
+
+
+_monitor = SkewMonitor()
+
+
+def monitor() -> SkewMonitor:
+    return _monitor
+
+
+def reset_monitor() -> None:
+    """Drop all smoothed state (tests; also correct after a recovery
+    epoch where ranks may have been reassigned)."""
+    global _monitor, _last_applied
+    _monitor = SkewMonitor()
+    _last_applied = None
+
+
+# The plan the most recent device_allreduce / device_hier_allreduce on
+# this host applied (``"<kind>@<laggard>"``) or None. The engines stamp
+# it into their round-carrying spans AFTER the device call, so
+# cross-rank stitching (telemetry/crossrank.py) can show which rounds
+# ran adapted; collectives write it on every call (None clears stale
+# state when adaptation disengages).
+_last_applied: Optional[str] = None
+
+
+def note_applied(tag: Optional[str]) -> None:
+    global _last_applied
+    _last_applied = tag
+
+
+def last_applied() -> Optional[str]:
+    return _last_applied
+
+
+# ------------------------------------------------------- adaptation plans
+
+
+def laggard_of(digest) -> Optional[int]:
+    return None if not digest else digest.get("laggard")
+
+
+def earliest_of(digest, world: int) -> int:
+    """The earliest-arrival rank (minimum smoothed offset) — the root
+    re-rooted trees and pre-aggregation folds elect. Falls back to the
+    lowest non-laggard rank when offsets are missing."""
+    lag = laggard_of(digest)
+    offs = (digest or {}).get("offsets_ms") or {}
+    cands = [(off, r) for r, off in offs.items()
+             if r != lag and 0 <= int(r) < world]
+    if cands:
+        return int(min(cands)[1])
+    return 1 if lag == 0 else 0
+
+
+def skew_ms_of(digest) -> float:
+    offs = (digest or {}).get("offsets_ms") or {}
+    if len(offs) < 2:
+        return 0.0
+    return max(offs.values()) - min(offs.values())
+
+
+def rotation_order(world: int, laggard: int):
+    """Logical rank order with the laggard rotated to the LAST slot —
+    it then owns the final position of every ring walk, so its late
+    contribution blocks the fewest downstream steps on an async
+    fabric."""
+    if not 0 <= laggard < world:
+        raise ValueError(f"laggard {laggard} outside world {world}")
+    return tuple((laggard + 1 + i) % world for i in range(world))
+
+
+def rotation_groups(world: int, laggard: int):
+    """The rotated order as a single-group ``groups`` tuple — the same
+    static argument the grouped ring/swing schedules already take, so
+    rotation rides existing machinery (and the jit cache keys on it)."""
+    return (rotation_order(world, laggard),)
+
+
+def demote_delegate(groups, laggard: int):
+    """Hier adaptation: move a lagging rank to the LAST slot of its
+    host group. Slot order defines both the intra-host ring position
+    and which inter-host slot ring the rank serves; the first slot is
+    the delegate ring, so a lagging delegate is demoted to the
+    tail slot and a prompt housemate takes over. Other groups are
+    untouched (group order and membership are preserved)."""
+    out = []
+    for grp in groups:
+        grp = tuple(grp)
+        if laggard in grp and grp[-1] != laggard:
+            grp = tuple(r for r in grp if r != laggard) + (laggard,)
+        out.append(grp)
+    return tuple(out)
+
+
+def preagg_groups(world: int, laggard: int):
+    """Membership encoding for the pre-aggregation schedule: the
+    arrived subgroup (flat order) and the laggard as a singleton —
+    hashable, so it rides the same static ``groups`` slot as the
+    rotations."""
+    if not 0 <= laggard < world:
+        raise ValueError(f"laggard {laggard} outside world {world}")
+    early = tuple(r for r in range(world) if r != laggard)
+    return (early, (laggard,))
+
+
+def adapt_plan(method: str, world: int, nbytes: int, op_name: str,
+               groups=None, digest=None) -> Optional[dict]:
+    """The pure adaptation decision for one dispatch.
+
+    Returns None (run the flat schedule unchanged) unless the digest
+    names a laggard inside this world. Otherwise:
+
+    - measured skew above ``rabit_skew_preagg_ms`` per MiB and a SUM
+      payload -> ``preagg`` (early subgroup reduces while waiting, the
+      laggard's contribution folds in on arrival);
+    - ``tree`` -> ``tree_reroot``: laggard to a leaf, earliest arrival
+      to the root (the XLA psum tree is rank-symmetric, so this records
+      the election; the rooted fold inside ``preagg`` is where the root
+      is load-bearing);
+    - ``hier`` -> ``hier_demote`` via :func:`demote_delegate`;
+    - ring/bidir/swing -> ``rotate`` via :func:`rotation_groups`.
+
+    Every plan only permutes the logical rank order or changes which
+    schedule runs — never the contributing rank set (property-tested).
+    """
+    lag = laggard_of(digest)
+    if lag is None or not 0 <= lag < world or world < 2:
+        return None
+    root = earliest_of(digest, world)
+    base = {"laggard": lag, "root": root, "epoch": digest.get("epoch", 0)}
+    thresh = preagg_ms_per_mib()
+    if (op_name == "sum" and world >= 2 and thresh > 0
+            and skew_ms_of(digest) >= thresh * max(nbytes, 1) / (1 << 20)
+            and method in ("tree", "ring", "bidir", "swing")):
+        return dict(base, kind="preagg", method="preagg",
+                    groups=preagg_groups(world, lag))
+    if method == "tree":
+        return dict(base, kind="tree_reroot", method="tree", groups=None)
+    if method == "hier":
+        if not groups:
+            return None
+        return dict(base, kind="hier_demote", method="hier",
+                    groups=demote_delegate(groups, lag))
+    if method in ("ring", "bidir", "swing"):
+        return dict(base, kind="rotate", method=method,
+                    groups=rotation_groups(world, lag))
+    return None
+
+
+def _smoke() -> None:
+    """CI contract (run_tests.sh tier 0g): a 2-rank allreduce on the
+    gloo-backed virtual mesh with a forced skew digest must elect the
+    re-rooted tree — digest -> monitor -> dispatch provenance ->
+    adapted schedule, end to end, with a correct reduction."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count=2").strip()
+    os.environ["RABIT_SKEW_ADAPT"] = "1"
+    os.environ["RABIT_SKEW_DIGEST"] = json.dumps(
+        {"epoch": 1, "offsets_ms": {"0": 40.0, "1": 0.0}, "laggard": 0})
+    os.environ["RABIT_SKEW_PREAGG_MS"] = "0"  # isolate the tree re-root
+    os.environ["RABIT_DISPATCH_TABLE"] = "none"
+    reset_monitor()
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from .. import telemetry
+    from ..ops.reducers import SUM
+    from ..parallel.collectives import device_allreduce
+
+    plan = adapt_plan("tree", 2, 64 * 4, "sum",
+                      digest=monitor().current())
+    assert plan is not None and plan["kind"] == "tree_reroot", plan
+    assert plan["laggard"] == 0 and plan["root"] == 1, plan
+
+    telemetry.reset(capacity=64, enabled=True)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("proc",))
+    xs = np.arange(2 * 64, dtype=np.float32).reshape(2, 64)
+    out = device_allreduce(
+        jax.device_put(xs, NamedSharding(mesh, P("proc"))), mesh, SUM,
+        axis="proc", method="auto")
+    np.testing.assert_array_equal(np.asarray(out), xs.sum(0))
+    rows = [c for c in telemetry.snapshot()["counters"]
+            if c["name"] == "dispatch"]
+    assert rows and all(c["provenance"] == "skew_adapted" for c in rows), \
+        rows
+    adapted = [c for c in telemetry.snapshot()["counters"]
+               if c["name"] == "dispatch.skew_adapted"]
+    assert adapted and adapted[0]["count"] >= 1, adapted
+    spans = [s for s in telemetry.snapshot()["spans"]
+             if s["name"] == "allreduce"]
+    assert spans and spans[0].get("attrs", {}).get("adapted") \
+        == "tree_reroot@0", spans
+    telemetry.reset(enabled=False)
+    print("skew smoke ok")
+
+
+if __name__ == "__main__":
+    import sys
+    if "--smoke" in sys.argv:
+        _smoke()
+    else:
+        print(__doc__)

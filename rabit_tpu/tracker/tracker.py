@@ -34,6 +34,14 @@ Wire protocol (binary, little-endian, length-prefixed strings):
                    hostname), plus the elected min-rank delegate per
                    host. "{}" before the first assignment. Feeds the
                    hierarchical collectives (parallel/topology.py).
+    skew:          (no extra fields) tracker -> worker: payload str, a
+                   JSON {"epoch","offsets_ms","laggard"} fleet skew
+                   digest derived from the poll loop's straggler
+                   snapshot (telemetry/skew.py) — per-rank mean arrival
+                   offsets in ms plus the elected laggard (null while
+                   no rank crosses the signal threshold). "{}" until a
+                   poll sweep has per-rank busy times. Feeds the
+                   skew-adaptive schedules (rabit_skew_adapt).
   tracker -> worker (start/recover): rank u32, world u32, epoch u32,
     coord_host str, coord_port u32 (this epoch's tracker-hosted device
     -world coordination service; empty/0 when coordinator hosting is
@@ -200,6 +208,10 @@ class Tracker:
         # host topology of the last completed assignment (the ``topo``
         # wire command's payload); {} until a batch assigns
         self._topo: dict = {}
+        # fleet skew digest {epoch, offsets_ms, laggard} (the ``skew``
+        # wire command's payload, telemetry/skew.py); {} until the poll
+        # loop has a sweep with per-rank busy times to derive one from
+        self._skew: dict = {}
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Tracker":
@@ -345,6 +357,7 @@ class Tracker:
             polls = self._poll_count
             strag = self._last_straggler
             topo = dict(self._topo)
+            skew_doc = dict(self._skew)
         gauges = [
             ("rabit_tracker_endpoints",
              "Worker metrics endpoints known to the tracker.",
@@ -374,6 +387,18 @@ class Tracker:
                 "rabit_straggler_busy_skew_seconds",
                 "Spread of per-rank collective busy time.", "gauge",
                 [({}, strag["busy_skew_s"])]))
+        if skew_doc.get("offsets_ms"):
+            gauges.append((
+                "rabit_skew_offset_ms",
+                "Per-rank mean arrival offset behind the earliest rank "
+                "(the skew digest served to workers).", "gauge",
+                [({"rank": str(r)}, v)
+                 for r, v in sorted(skew_doc["offsets_ms"].items(),
+                                    key=lambda kv: int(kv[0]))]))
+            gauges.append((
+                "rabit_skew_epoch",
+                "Topology epoch the current skew digest was derived in.",
+                "gauge", [({}, skew_doc.get("epoch", 0))]))
         return gauges
 
     def _straggler_doc(self) -> dict:
@@ -383,7 +408,7 @@ class Tracker:
                                                 "signal": False}
 
     def _poll_loop(self) -> None:
-        from ..telemetry import crossrank, live
+        from ..telemetry import crossrank, live, skew
         interval = live.poll_interval_s()
         since_snapshot = 0
         while not self._poll_stop.wait(interval):
@@ -400,8 +425,12 @@ class Tracker:
                 summaries = dict(self._metrics)
                 self._poll_count += 1
             strag = crossrank.straggler_snapshot(summaries)
+            digest = skew.digest_from_snapshot(
+                strag, epoch=self._topo.get("epoch", 0))
             with self._lock:
                 self._last_straggler = strag
+                if digest is not None:
+                    self._skew = digest
             # periodic straggler snapshot: one line every ~5 sweeps,
             # only while someone is actually behind — in the round
             # sequence, or >1s of accumulated in-collective wait
@@ -513,6 +542,11 @@ class Tracker:
             elif cmd == "topo":
                 with self._lock:
                     doc = dict(self._topo)
+                _send_str(conn, json.dumps(doc))
+                conn.close()
+            elif cmd == "skew":
+                with self._lock:
+                    doc = dict(self._skew)
                 _send_str(conn, json.dumps(doc))
                 conn.close()
             elif cmd == "shutdown":

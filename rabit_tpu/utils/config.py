@@ -35,6 +35,9 @@ ENV_VARS = [
     "RABIT_HIER",
     "RABIT_HIER_GROUP",
     "RABIT_HIER_PHASE_DEADLINE_SCALE",
+    "RABIT_SKEW_ADAPT",
+    "RABIT_SKEW_PREAGG_MS",
+    "RABIT_SKEW_POLL_MS",
     "RABIT_TELEMETRY",
     "RABIT_TELEMETRY_BUFFER",
     "RABIT_TELEMETRY_EXPORT",

@@ -22,7 +22,7 @@ if command -v ruff >/dev/null 2>&1; then
       rabit_tpu/engine/dataplane.py rabit_tpu/utils/watchdog.py \
       rabit_tpu/chaos/proxy.py rabit_tpu/telemetry/prom.py \
       rabit_tpu/telemetry/live.py rabit_tpu/telemetry/profile.py \
-      rabit_tpu/tracker/tracker.py
+      rabit_tpu/telemetry/skew.py rabit_tpu/tracker/tracker.py
 else
   # containers without ruff fall back to the stdlib-only subset
   python tools/lint.py
@@ -47,6 +47,12 @@ echo "== tier 0f: hierarchical dispatch smoke (sweep incl. hier column) =="
 # table must round-trip through the dispatch loader
 JAX_PLATFORMS=cpu python tools/collective_sweep.py --smoke \
     --out /tmp/rabit_sweep_smoke.json
+
+echo "== tier 0g: skew-adaptation smoke (digest -> dispatch -> re-root) =="
+# a forced skew digest must flow digest -> monitor -> dispatch
+# provenance -> adapted (re-rooted tree) schedule on a 2-rank mesh,
+# with the reduction still numerically correct
+JAX_PLATFORMS=cpu python -m rabit_tpu.telemetry.skew --smoke
 
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null

@@ -74,7 +74,7 @@ def test_load_table_env_disable(monkeypatch, table_file):
 
 
 @pytest.mark.parametrize("mutate", [
-    lambda d: d.__setitem__("schema", "rabit_tpu.collective_sweep/v2"),
+    lambda d: d.__setitem__("schema", "rabit_tpu.collective_sweep/v99"),
     lambda d: d.pop("table"),
     lambda d: d["table"].pop("other"),
     # last row must be open-ended (max_n null) to cover every size
@@ -92,6 +92,21 @@ def test_load_table_rejects_malformed(tmp_path, monkeypatch, mutate):
     monkeypatch.setenv("RABIT_DISPATCH_TABLE", str(p))
     dispatch.clear_cache()
     assert dispatch.load_table() is None
+
+
+def test_load_table_accepts_v1_schema(tmp_path, monkeypatch):
+    """Committed pre-lag sweep artifacts (schema v1) must keep loading
+    after the v2 bump — the lag columns are additive."""
+    old = json.loads(json.dumps(VALID_TABLE))
+    old["schema"] = "rabit_tpu.collective_sweep/v1"
+    p = tmp_path / "COLLECTIVE_SWEEP_v1.json"
+    p.write_text(json.dumps(old))
+    monkeypatch.setenv("RABIT_DISPATCH_TABLE", str(p))
+    dispatch.clear_cache()
+    try:
+        assert dispatch.load_table() is not None
+    finally:
+        dispatch.clear_cache()
 
 
 def test_load_table_not_json(tmp_path, monkeypatch):

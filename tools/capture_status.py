@@ -25,8 +25,13 @@ metrics endpoint (``rabit_metrics_port``, telemetry/live.py) instead
 of the on-disk evidence set: it GETs ``/healthz`` and ``/metrics``,
 validates the Prometheus exposition, and emits one
 ``rabit_tpu.live_status/v1`` JSON line (identity, sample count,
-collective counter total). Exit 0 when the endpoint is healthy,
-1 when unreachable or unhealthy.
+collective counter total). Against the tracker it additionally GETs
+``/straggler`` (best-effort; rank endpoints 404) and renders the
+detector's verdict EXPLICITLY: ``signal=true`` names the laggard,
+while a tie (``signal=false`` with a ``candidate_rank``) is reported
+as ``verdict: tie`` — the candidate is the tie-break's would-be pick,
+never an accusation the detector itself declined to make. Exit 0 when
+the endpoint is healthy, 1 when unreachable or unhealthy.
 """
 
 import glob
@@ -159,6 +164,31 @@ def live_status(target):
             except (ValueError, IndexError):
                 pass
     doc["collectives_total"] = collectives
+    # /straggler is a tracker-only route; rank endpoints 404 and the
+    # field is simply absent (scrape health is judged without it)
+    try:
+        with urllib.request.urlopen(base + "/straggler", timeout=5.0) as r:
+            strag = json.load(r)
+    except (OSError, ValueError, urllib.error.URLError):
+        strag = None
+    if isinstance(strag, dict) and "signal" in strag:
+        if strag.get("signal") and strag.get("lagging_rank") is not None:
+            doc["straggler"] = {
+                "verdict": "lagging",
+                "rank": strag["lagging_rank"],
+                "lag_collectives": strag.get("lag_collectives", 0),
+                "busy_skew_s": strag.get("busy_skew_s", 0.0)}
+        elif strag.get("candidate_rank") is not None:
+            # signal=false + candidate: the detector measured a
+            # tie-break winner but declined to name a laggard — report
+            # the tie as such instead of printing the candidate as if
+            # accused
+            doc["straggler"] = {
+                "verdict": "tie",
+                "candidate_rank": strag["candidate_rank"],
+                "busy_skew_s": strag.get("busy_skew_s", 0.0)}
+        else:
+            doc["straggler"] = {"verdict": "none"}
     doc["ok"] = bool(health.get("ok")) and doc["exposition_ok"]
     return doc, doc["ok"]
 

@@ -29,11 +29,21 @@ records whether (and which) quantized wire beat the unquantized one at
 that size — dispatch uses it as the gate for a user-REQUESTED wire,
 never to auto-enable lossy compression.
 
+``--lag-rank N --lag-ms M`` injects a calibrated synthetic burn on one
+rank before every collective in the chain, so skew-adaptive crossovers
+(rabit_skew_adapt, telemetry/skew.py) can be measured exactly the way
+size crossovers are: the same slope timing, but under a deliberately
+imbalanced arrival pattern. Each emitted row then carries
+``lag_rank``/``lag_ms`` columns recording the injected skew — the
+reason for the v2 schema bump (dispatch.py still loads committed v1
+artifacts).
+
 Writes ``COLLECTIVE_SWEEP_<ts>.json`` (schema
-``rabit_tpu.collective_sweep/v1``) at the repo root, where
-``parallel/dispatch.py`` discovers the newest one.
+``rabit_tpu.collective_sweep/v2``) under ``benchmarks/artifacts/``,
+where ``parallel/dispatch.py`` discovers the newest one.
 
 Usage: python tools/collective_sweep.py [--smoke] [--world N]
+                                        [--lag-rank N] [--lag-ms M]
                                         [--out PATH]
   --smoke   CI contract check: one tiny size, noisy timing allowed,
             still emits a schema-valid artifact (to --out if given).
@@ -66,7 +76,32 @@ def _ensure_devices(world: int) -> None:
         ).strip()
 
 
-def _make_run(mesh, axis, n, dtype, op, method, wire, groups=None):
+def _calibrate_burn(lag_ms: float) -> int:
+    """Iterations of the scalar burn loop that take ~``lag_ms`` on this
+    backend — measured, not assumed (CPU vs TPU scalar throughput
+    differs by orders of magnitude)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def burn(k):
+        return lax.fori_loop(
+            0, k, lambda i, v: v * jnp.float32(1.0000001) + 1e-9,
+            jnp.float32(1.0))
+
+    burn(jnp.int32(1000)).block_until_ready()  # compile once
+    k = 1_000_000
+    t0 = time.perf_counter()
+    burn(jnp.int32(k)).block_until_ready()
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return max(1, int(k * (lag_ms / 1000.0) / dt))
+
+
+def _make_run(mesh, axis, n, dtype, op, method, wire, groups=None,
+              lag_rank=None, lag_iters=0):
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -80,6 +115,17 @@ def _make_run(mesh, axis, n, dtype, op, method, wire, groups=None):
         x = x.reshape(-1)
 
         def body(_, acc):
+            if lag_rank is not None and lag_iters > 0:
+                # deliberate arrival skew: only the lagging rank burns
+                # (loop bound is rank-dependent), and the burn result
+                # feeds back through a float *0.0 — not foldable, the
+                # values are untouched but the collective must wait
+                idx = lax.axis_index(axis)
+                dummy = lax.fori_loop(
+                    0, lag_iters * (idx == lag_rank).astype(jnp.int32),
+                    lambda i, v: v * jnp.float32(1.0000001) + 1e-9,
+                    jnp.float32(1.0))
+                acc = acc + (dummy * jnp.float32(0.0)).astype(acc.dtype)
             r = _per_shard_allreduce(acc + salt, axis, op, method, wire,
                                      groups=groups)
             if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
@@ -127,7 +173,8 @@ def _check_correct(mesh, axis, method, wire, dtype, op,
                                else 5e-2)
 
 
-def sweep(world: int, sizes, smoke: bool, ranks_per_host: int = 2) -> dict:
+def sweep(world: int, sizes, smoke: bool, ranks_per_host: int = 2,
+          lag_rank=None, lag_ms: float = 0.0) -> dict:
     import jax
 
     from rabit_tpu.ops.reducers import SUM
@@ -153,6 +200,10 @@ def sweep(world: int, sizes, smoke: bool, ranks_per_host: int = 2) -> dict:
     if not topology.is_hierarchical(groups, world):
         groups = None
     k_small, k_big = (2, 4) if smoke else (2, 8)
+    lagging = lag_rank is not None and lag_ms > 0
+    if lagging and not 0 <= lag_rank < world:
+        raise ValueError(f"--lag-rank {lag_rank} outside world {world}")
+    lag_iters = _calibrate_burn(lag_ms) if lagging else 0
     rows = []
     for dtype, op, section in (("float32", SUM, "float_sum"),
                                ("int32", SUM, "other")):
@@ -167,16 +218,22 @@ def sweep(world: int, sizes, smoke: bool, ranks_per_host: int = 2) -> dict:
                                groups=g)
                 for n in sizes:
                     run = _make_run(mesh, "sweep", n, dtype, op, method,
-                                    wire, groups=g)
+                                    wire, groups=g,
+                                    lag_rank=lag_rank if lagging else None,
+                                    lag_iters=lag_iters)
                     s = slope_time(run, k_small, k_big,
                                    allow_noisy=smoke)
                     row = {"section": section, "method": method,
-                           "wire": wire, "n": n, "s_per_op": s}
+                           "wire": wire, "n": n, "s_per_op": s,
+                           "lag_rank": lag_rank if lagging else None,
+                           "lag_ms": lag_ms if lagging else 0.0}
                     rows.append(row)
                     print(json.dumps(row), flush=True)
     return {"world": world, "backend": jax.default_backend(),
             "k": [k_small, k_big],
             "ranks_per_host": ranks_per_host if groups else 1,
+            "lag": ({"rank": lag_rank, "ms": lag_ms, "iters": lag_iters}
+                    if lagging else None),
             "rows": rows}
 
 
@@ -225,6 +282,11 @@ def main() -> None:
     ap.add_argument("--ranks-per-host", type=int, default=2,
                     help="simulated ranks per host for the hier column "
                          "(<=1 or non-divisor drops hier from the sweep)")
+    ap.add_argument("--lag-rank", type=int, default=None,
+                    help="rank that burns --lag-ms before every "
+                         "collective (skew-crossover measurement)")
+    ap.add_argument("--lag-ms", type=float, default=0.0,
+                    help="calibrated per-collective burn on --lag-rank")
     ap.add_argument("--out", default=None,
                     help="artifact path (default: repo root, timestamped)")
     args = ap.parse_args()
@@ -236,7 +298,8 @@ def main() -> None:
 
     sizes = SMOKE_SIZES if args.smoke else FULL_SIZES
     result = sweep(args.world, sizes, args.smoke,
-                   ranks_per_host=args.ranks_per_host)
+                   ranks_per_host=args.ranks_per_host,
+                   lag_rank=args.lag_rank, lag_ms=args.lag_ms)
     result["schema"] = SCHEMA
     result["table"] = derive_table(result["rows"], sizes)
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(

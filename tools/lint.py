@@ -67,7 +67,8 @@ SPAN_REQUIRED = {
     os.path.join("rabit_tpu", "parallel", "collectives.py"): {
         "device_allreduce", "device_allreduce_tree", "device_broadcast",
         "device_reduce_scatter", "device_allgather",
-        "device_hier_allreduce", "_per_shard_allreduce"},
+        "device_hier_allreduce", "_per_shard_allreduce",
+        "preagg_allreduce"},
     os.path.join("rabit_tpu", "engine", "base.py"): {
         "reduce_scatter", "allgather"},
     os.path.join("rabit_tpu", "engine", "xla.py"): {
@@ -112,6 +113,7 @@ T003_SCAN = (
     os.path.join("rabit_tpu", "tracker", "tracker.py"),
     os.path.join("rabit_tpu", "engine", "xla.py"),
     os.path.join("rabit_tpu", "engine", "native.py"),
+    os.path.join("rabit_tpu", "telemetry", "skew.py"),
 )
 
 _T003_TYPES = {"counter", "gauge", "histogram"}

@@ -9,7 +9,8 @@ schema-versioned artifact the repo emits —
 - ``rabit_tpu.telemetry_fleet/v1``   (tracker-merged fleet stats)
 - ``rabit_tpu.telemetry_trace/v1``   (Chrome trace-event file — also
   loadable directly in https://ui.perfetto.dev / chrome://tracing)
-- ``rabit_tpu.collective_sweep/v1``  (dispatch-table artifacts)
+- ``rabit_tpu.collective_sweep/v1``/``v2``  (dispatch-table artifacts;
+  v2 adds the lag-injection skew columns)
 - ``rabit_tpu.flight_record/v1``     (crash flight-recorder bundles —
   last spans, noted wire/chaos events, per-thread stacks)
 - ``rabit_tpu.bench_sentinel/v1``    (regression-sentinel verdicts —
@@ -189,15 +190,20 @@ def render_skew(docs):
         return None
     # hierarchical allreduces stitch as one row PER PHASE (the three
     # hier.* spans share a round id): the phase column turns "round 7
-    # straggled" into "round 7 straggled in the inter-host phase"
+    # straggled" into "round 7 straggled in the inter-host phase".
+    # The adaptation column shows which skew plan (rotate / tree_reroot
+    # / preagg / hier_demote @ laggard) a round ran under — "-" rounds
+    # ran the flat schedule, so adapted vs unadapted skew is comparable
+    # in the same table
     rows = [(r["name"], r["round"], r.get("phase") or "-",
+             r.get("adapted") or "-",
              len(r["arrivals"]), r["straggler_rank"], _fmt_s(r["skew_s"]),
              _fmt_s(r["critical_path_s"])) for r in comparable]
     out = (f"Cross-rank rounds ({len(comparable)} comparable of "
            f"{len(rounds)} stitched)\n\n" +
-           _md_table(("collective", "round", "phase", "ranks",
-                      "straggler", "arrival skew", "critical path"),
-                     rows))
+           _md_table(("collective", "round", "phase", "adaptation",
+                      "ranks", "straggler", "arrival skew",
+                      "critical path"), rows))
     attr = crossrank.skew_table(comparable)
     arow = [(a["rank"], a["rounds"], a["straggler_rounds"],
              _fmt_s(a["skew_caused_s"]), _fmt_s(a["worst_skew_s"]))
@@ -250,7 +256,8 @@ def recognized(doc):
     if not isinstance(doc, dict):
         return False
     return (any(matches(doc, k) for k in _KINDS)
-            or doc.get("schema") == "rabit_tpu.collective_sweep/v1")
+            or doc.get("schema") in ("rabit_tpu.collective_sweep/v1",
+                                     "rabit_tpu.collective_sweep/v2"))
 
 
 def render(doc):
@@ -262,7 +269,8 @@ def render(doc):
         return render_flight(doc)
     if matches(doc, "bench_sentinel"):
         return render_sentinel(doc)
-    if doc.get("schema") == "rabit_tpu.collective_sweep/v1":
+    if doc.get("schema") in ("rabit_tpu.collective_sweep/v1",
+                             "rabit_tpu.collective_sweep/v2"):
         return render_sweep(doc)
     raise SystemExit(f"unrecognized artifact schema {doc.get('schema')!r}")
 
@@ -343,6 +351,18 @@ def smoke(out_dir):
     assert hskew is not None, "hier phase rounds did not stitch"
     for nm, ph in phases:
         assert nm in hskew and ph in hskew, (nm, ph, hskew)
+    # adapted rounds carry their plan into the adaptation column;
+    # unadapted rounds render "-" in the same table
+    adap = [{"rank": rk, "t_base_unix": 0.0,
+             "spans": [{"name": "engine.allreduce", "t0": 0.01 * rk,
+                        "dur": 1e-3,
+                        "attrs": {"round": 1, "adapted": "rotate@2"}},
+                       {"name": "engine.allreduce", "t0": 0.2 + 0.01 * rk,
+                        "dur": 1e-3, "attrs": {"round": 2}}]}
+            for rk in (0, 1)]
+    askew = render_skew(adap)
+    assert askew is not None and "adaptation" in askew, askew
+    assert "rotate@2" in askew, askew
     telemetry.reset()
     print("telemetry smoke ok")
 
