@@ -150,6 +150,7 @@ class XlaDataPlane:
         from ..telemetry import skew as _skewmod
         _skewmod.preagg_ms_per_mib()   # raises ValueError on garbage
         _skewmod.poll_interval_s()     # raises ValueError on garbage
+        _skewmod.sync_rounds()         # raises ValueError on garbage
         # keep the ctypes callback object alive for the C side
         self.c_callback = DATAPLANE_CB(self._invoke)
 
@@ -253,6 +254,13 @@ class XlaDataPlane:
         self._mesh = Mesh(np.array([reps[i] for i in sorted(reps)]),
                           ("proc",))
         self._formed_epoch = epoch
+        # re-arm the skew agreement boundary: every process of the new
+        # epoch passes through here before its first collective, so the
+        # dispatch counters restart together and the first dispatch
+        # re-agrees on a digest before anything adapts (ranks may have
+        # been reassigned — the old agreed digest is dropped)
+        from ..telemetry import skew as _skewmod
+        _skewmod.reset_sync()
         telemetry.record_span("recovery.world_reform",
                               time.perf_counter() - t0,
                               provenance="recovery", epoch=epoch,

@@ -283,16 +283,18 @@ class NativeEngine(Engine):
     def _export_skew(self, cfg) -> None:
         """Skew-adaptation knobs -> env for the XLA data plane, plus the
         tracker address (``RABIT_SKEW_TRACKER``) so the worker-side
-        :class:`telemetry.skew.SkewMonitor` can pull the fleet digest
-        (the ``skew`` wire command) lazily from the dispatch path. Only
-        exported when adaptation is requested — with the knob unset no
-        skew env exists and the dispatch path never consults the
-        module."""
+        :class:`telemetry.skew.SkewMonitor` poller thread can refresh
+        the fleet digest (the ``skew`` wire command) off the dispatch
+        path. Only exported when adaptation is requested — with the
+        knob unset no skew env exists and the dispatch path never
+        consults the module."""
         self._export_env("RABIT_SKEW_ADAPT", cfg.get("rabit_skew_adapt", ""))
         self._export_env("RABIT_SKEW_PREAGG_MS",
                          cfg.get("rabit_skew_preagg_ms", ""))
         self._export_env("RABIT_SKEW_POLL_MS",
                          cfg.get("rabit_skew_poll_ms", ""))
+        self._export_env("RABIT_SKEW_SYNC_ROUNDS",
+                         cfg.get("rabit_skew_sync_rounds", ""))
         if cfg.get_bool("rabit_skew_adapt"):
             host = cfg.get("rabit_tracker_uri")
             port = cfg.get_int("rabit_tracker_port", 0)

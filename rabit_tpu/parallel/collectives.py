@@ -704,9 +704,11 @@ def preagg_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
        psum/pmax/pmin; the laggard sits in a singleton group and
        exchanges nothing — on an async fabric this phase completes
        while the laggard is still on its way);
-    2. on arrival, one full-duplex ppermute exchange at the fold root:
-       the laggard's raw vector goes out, the subgroup result comes
-       back;
+    2. on arrival, one full-duplex ppermute exchange at the fold root
+       — ``early[0]``, which ``adapt_plan`` places so that the elected
+       earliest-arrival rank leads the early tuple
+       (``skew.preagg_groups(root=...)``): the laggard's raw vector
+       goes out, the subgroup result comes back;
     3. the laggard's vector binomially doubles to the remaining ranks
        and every rank folds locally.
 
@@ -876,6 +878,48 @@ def _allreduce_global(xs, mesh: Mesh, axis: str, op: int, method: str,
     return f(xs)
 
 
+def _skew_sync_point(mesh: Mesh, axis: str) -> None:
+    """Fleet agreement boundary for skew adaptation.
+
+    Adapted methods/groups are STATIC jit arguments: in a
+    multi-controller SPMD world every process must derive them from the
+    same digest, or processes trace different programs for the same
+    collective round and deadlock. This helper fires at deterministic
+    dispatch counts (``skew.sync_due`` — program order is the
+    rendezvous, identical on every process) and, in multi-process
+    worlds, broadcasts process 0's candidate digest over the device
+    fabric as a fixed-shape float vector whose program is
+    digest-independent. Every process adopts the broadcast result —
+    :meth:`SkewMonitor.applied` — and ONLY that digest ever reaches
+    ``adapt_plan`` or dispatch, so schedules switch in lockstep at
+    agreed boundaries (the digest's tracker-side epoch says which
+    election is in force). Single-process worlds adopt the local
+    candidate directly; multi-axis multi-process meshes (no engine
+    builds one) conservatively adopt None — adaptation stays off
+    rather than risking a divergent broadcast layout."""
+    if not _skew.sync_due():
+        return
+    mon = _skew.monitor()
+    if jax.process_count() == 1:
+        mon.set_applied(mon.current())
+        return
+    if len(mesh.axis_names) != 1:
+        mon.set_applied(None)
+        return
+    world = mesh.shape[axis]
+    vec = np.asarray(_skew.encode_digest(mon.current(), world), np.float32)
+    shards = [jax.device_put(vec.reshape(1, -1), d)
+              for d in mesh.local_devices]
+    xs = jax.make_array_from_single_device_arrays(
+        (world, vec.size), NamedSharding(mesh, P(axis)),
+        shards)
+    out = _broadcast_global(xs, mesh, axis, 0)
+    agreed = _skew.decode_digest(
+        np.asarray(out.addressable_data(0)).reshape(-1))
+    mon.set_applied(agreed)
+    telemetry.count("dispatch.skew_sync")
+
+
 def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
                      axis: Optional[str] = None,
                      method: str = "auto",
@@ -911,6 +955,10 @@ def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
     if axis is None:
         axis = mesh.axis_names[0]
     n = int(np.prod(xs.shape[1:]))
+    if _skew.adapt_enabled():
+        # BEFORE resolve: dispatch's method-family election reads the
+        # agreed digest too, so it must be current at this boundary
+        _skew_sync_point(mesh, axis)
     groups = _topology.resolve_groups(mesh.shape[axis], explicit=groups)
     method, wire = _dispatch_resolve(n, xs.dtype, op, mesh.shape[axis],
                                      method=method, wire=wire,
@@ -922,11 +970,13 @@ def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
     if _skew.adapt_enabled():
         # skew adaptation only permutes the schedule (rotation groups /
         # preagg fold order are static jit args); arithmetic per rank
-        # pair is unchanged, so the replay contract holds
+        # pair is unchanged, so the replay contract holds. Only the
+        # fleet-AGREED digest may steer it: a per-process candidate is
+        # a divergent static jit arg in a multi-controller world.
         plan = _skew.adapt_plan(method, mesh.shape[axis],
                                 n * xs.dtype.itemsize,
                                 OP_NAMES.get(op, str(op)), groups=groups,
-                                digest=_skew.monitor().current())
+                                digest=_skew.monitor().applied())
         if plan is not None:
             method, groups = plan["method"], plan["groups"]
             if method == "preagg":
@@ -1118,11 +1168,14 @@ def device_hier_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
     if _skew.adapt_enabled():
         # demote a lagging delegate to the tail of its host group: slot 0
         # (the inter-host delegate ring) moves to the earliest co-hosted
-        # rank, the laggard only participates intra-host
+        # rank, the laggard only participates intra-host. Same agreement
+        # contract as device_allreduce: sync first, act only on the
+        # fleet-agreed digest.
+        _skew_sync_point(mesh, axis)
         plan = _skew.adapt_plan("hier", p, int(np.prod(xs.shape[1:]))
                                 * xs.dtype.itemsize,
                                 OP_NAMES.get(op, str(op)), groups=groups,
-                                digest=_skew.monitor().current())
+                                digest=_skew.monitor().applied())
         if plan is not None:
             groups = plan["groups"]
             adapted = f"{plan['kind']}@{plan['laggard']}"

@@ -179,12 +179,13 @@ def resolve(n: int, dtype, op: int, axis_size: int,
     An EXPLICIT ``method="hier"`` on such a world degrades to ``ring``,
     the same degradation contract as swing on a non-power-of-two world.
 
-    With ``rabit_skew_adapt`` on and a live digest naming a laggard,
-    auto additionally prefers skew-tolerant shapes (swing/bidir →
-    tree/ring by size) and stamps provenance ``skew_adapted`` plus the
-    ``dispatch.skew_adapted`` counter; the concrete re-root / rotation /
-    pre-aggregation plan is applied by ``device_allreduce``
-    (``telemetry/skew.py``).
+    With ``rabit_skew_adapt`` on and the fleet-agreed digest (adopted
+    at the last agreement boundary; never a per-process candidate)
+    naming a laggard inside this world, auto additionally prefers
+    skew-tolerant shapes (swing/bidir → tree/ring by size) and stamps
+    provenance ``skew_adapted`` plus the ``dispatch.skew_adapted``
+    counter; the concrete re-root / rotation / pre-aggregation plan is
+    applied by ``device_allreduce`` (``telemetry/skew.py``).
 
     ``wire="auto"``: engages the ``RABIT_DATAPLANE_WIRE`` env wire (the
     ``rabit_dataplane_wire`` config export) only where measurement says
@@ -231,12 +232,19 @@ def resolve(n: int, dtype, op: int, axis_size: int,
         method = "ring"  # swing needs a power-of-two world
     adapted = False
     if requested == "auto" and skew.adapt_enabled():
-        # live skew consult: with a digest naming a persistent laggard,
-        # prefer skew-tolerant shapes — the fixed-topology involutions
+        # live skew consult: with the fleet-AGREED digest (the applied
+        # digest from the last sync boundary — never a per-process
+        # candidate, which would make the elected method a divergent
+        # static jit arg) naming a persistent laggard, prefer
+        # skew-tolerant shapes — the fixed-topology involutions
         # (swing, bidir) have no good place to park a laggard, while
         # tree re-roots and ring rotates (collectives apply the actual
-        # plan; here only the method family is elected)
-        if skew.laggard_of(skew.monitor().current()) is not None:
+        # plan; here only the method family is elected). The laggard
+        # must be a rank of THIS world: a stale digest naming a rank
+        # outside it yields no plan downstream, and provenance must
+        # not report adaptation for rounds that ran flat.
+        lag = skew.laggard_of(skew.monitor().applied())
+        if lag is not None and 0 <= lag < axis_size and axis_size >= 2:
             adapted = True
             if method in ("swing", "bidir"):
                 method = ("tree" if n < RING_MINCOUNT_DEFAULT else "ring")

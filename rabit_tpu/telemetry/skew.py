@@ -7,18 +7,34 @@ every schedule assumed ranks arrive together, which arXiv:1804.05349
 shows leaves large fractions of round time on the table under
 imbalanced process arrival.
 
-Three pieces live here, all plain Python (no jax import — the tracker
+Four pieces live here, all plain Python (no jax import — the tracker
 uses the estimator and the digest builder without an accelerator
 stack):
 
 - :class:`SkewEstimator` — an EWMA of per-rank arrival offsets with
   hysteresis on the laggard election, so one noisy round cannot flip
-  the adapted schedule (and with it the jit cache key) back and forth;
+  the adapted schedule (and with it the jit cache key) back and forth.
+  It runs ONLY inside the tracker's :class:`FleetElection`: there is
+  exactly one election for the whole fleet, never a per-process
+  opinion — adapted methods/groups are static jit arguments to
+  multi-controller SPMD programs, and processes that trace different
+  schedules for the same round deadlock;
 - the fleet **skew digest** ``{epoch, offsets_ms, laggard}`` — built
   tracker-side from the ``/straggler`` poll sweep
-  (:func:`digest_from_snapshot`), served over the ``skew`` wire command
-  (mirroring ``topo``), fetched worker-side by :func:`fetch_skew`, and
-  cached/refreshed by the process-global :class:`SkewMonitor`;
+  (:func:`digest_from_snapshot` -> :class:`FleetElection`, whose epoch
+  bumps exactly when the election changes), served over the ``skew``
+  wire command (mirroring ``topo``), fetched worker-side by a
+  background thread owned by the process-global :class:`SkewMonitor`
+  and applied VERBATIM — no worker-side smoothing;
+- the **agreement boundary**: a tracker-fetched digest is only a
+  *candidate* until every process has adopted the same one. Dispatch
+  calls :func:`sync_due` (a pure function of a per-process dispatch
+  counter all SPMD processes advance in program order) and, when due,
+  broadcasts process 0's candidate over the device fabric
+  (:func:`encode_digest` / :func:`decode_digest`,
+  ``parallel/collectives._skew_sync_point``); only the broadcast
+  result ever reaches :func:`adapt_plan`, so every process applies
+  byte-identical plans or none at all;
 - the pure **adaptation plan** (:func:`adapt_plan` and its helpers) —
   given a method, world size, and digest, decide the re-rooted /
   rotated / pre-aggregating schedule. Pure functions on ints, so the
@@ -32,12 +48,13 @@ from __future__ import annotations
 
 import json
 import os
-import time
+import threading
 from typing import Dict, Optional
 
 _ADAPT_ENV = "RABIT_SKEW_ADAPT"
 _PREAGG_ENV = "RABIT_SKEW_PREAGG_MS"
 _POLL_ENV = "RABIT_SKEW_POLL_MS"
+_SYNC_ENV = "RABIT_SKEW_SYNC_ROUNDS"
 _DIGEST_ENV = "RABIT_SKEW_DIGEST"
 _TRACKER_ENV = "RABIT_SKEW_TRACKER"
 
@@ -49,11 +66,30 @@ _ON = ("1", "true", "yes", "on")
 # cross-host straggler this repo has measured (BUSY_SKEW_SIGNAL_S = 1s).
 PREAGG_MS_PER_MIB_DEFAULT = 2.0
 
-# Digest refresh cadence (worker-side pull of the tracker's `skew`
-# command). Floored like the metrics poll: sub-100ms polling would put
-# socket latency on the dispatch path.
+# Digest refresh cadence (worker-side background pull of the tracker's
+# `skew` command). Floored like the metrics poll: the fetch runs off
+# the dispatch path, but a sub-100ms poll would still hammer the
+# tracker's accept loop for no fresher data than its own sweep cadence.
 POLL_MS_DEFAULT = 2000
 POLL_MS_FLOOR = 100
+
+# Background-fetch socket budget and circuit breaker: a dead or wedged
+# tracker costs at most FETCH_TIMEOUT_S per attempt on the poller
+# thread (never the dispatch path), and after BREAKER_FAILURES
+# consecutive misses the poller backs off to BREAKER_BACKOFF x the
+# poll interval (one success re-arms it).
+FETCH_TIMEOUT_S = 1.0
+BREAKER_FAILURES = 3
+BREAKER_BACKOFF = 10
+
+# How many adapt-enabled dispatches run between fleet agreement
+# boundaries. Static schedule state may only change AT a boundary:
+# every process reaches its k-th adaptable dispatch in the same
+# program order, so "counter % sync_rounds == 0" is a fleet-wide
+# rendezvous without any extra control plane. 1 agrees before every
+# collective (one tiny broadcast each); larger amortizes the sync at
+# the cost of applying a new election up to N-1 rounds late.
+SYNC_ROUNDS_DEFAULT = 32
 
 # EWMA smoothing and laggard-flip hysteresis defaults. A challenger
 # must beat the incumbent laggard's smoothed offset by HYSTERESIS_MS
@@ -96,6 +132,21 @@ def poll_interval_s() -> float:
         raise ValueError(
             f"{_POLL_ENV} must be an integer (ms), got {v!r}")
     return max(ms, POLL_MS_FLOOR) / 1000.0
+
+
+def sync_rounds() -> int:
+    """Dispatches between fleet agreement boundaries
+    (``rabit_skew_sync_rounds``, floor 1). Must be uniform across
+    ranks — the boundary IS the cross-process rendezvous."""
+    v = os.environ.get(_SYNC_ENV)
+    if not v:
+        return SYNC_ROUNDS_DEFAULT
+    try:
+        n = int(v)
+    except ValueError:
+        raise ValueError(
+            f"{_SYNC_ENV} must be an integer (dispatch count), got {v!r}")
+    return max(n, 1)
 
 
 # --------------------------------------------------------------- estimator
@@ -152,6 +203,46 @@ class SkewEstimator:
         return max(vals) - min(vals)
 
 
+class FleetElection:
+    """Tracker-side: the ONE smoothed, hysteretic laggard election the
+    whole fleet shares.
+
+    Each ``/straggler`` poll sweep's raw digest folds through the EWMA
+    estimator; the served digest carries the estimator's smoothed
+    offsets and its hysteretic laggard (suppressed while the sweep's
+    own verdict is a tie — a digest must never accuse a candidate the
+    detector declined to name). The epoch bumps exactly when the
+    served laggard changes, so workers' jit cache keys are stable for
+    as long as the election holds and a schedule switch is always
+    attributable to an epoch transition. Smoothing lives HERE and not
+    in the workers so every process receives the same election —
+    per-process EWMAs fed by independently-timed fetches diverge, and
+    divergent elections are divergent static jit args (deadlock)."""
+
+    def __init__(self, alpha: float = EWMA_ALPHA,
+                 hysteresis_ms: float = HYSTERESIS_MS):
+        self._est = SkewEstimator(alpha=alpha, hysteresis_ms=hysteresis_ms)
+        self._epoch = 0
+        self._laggard: Optional[int] = None
+
+    def fold(self, raw: Optional[dict]) -> Optional[dict]:
+        """Fold one sweep's raw digest; returns the digest to serve
+        (None if there is nothing to fold and never has been)."""
+        if raw is not None:
+            self._est.update(raw.get("offsets_ms") or {})
+            lag = (self._est.laggard
+                   if raw.get("laggard") is not None else None)
+            if self._epoch == 0 or lag != self._laggard:
+                self._laggard = lag
+                self._epoch += 1
+        if self._epoch == 0:
+            return None
+        return {"epoch": self._epoch,
+                "offsets_ms": {str(r): round(v, 3) for r, v in
+                               self._est.offsets_ms().items()},
+                "laggard": self._laggard}
+
+
 # ----------------------------------------------------------------- digest
 
 
@@ -202,11 +293,14 @@ def parse_digest(doc) -> Optional[dict]:
 
 
 def fetch_skew(host: str, port: int, task_id: str = "0",
-               timeout: float = 5.0) -> Optional[dict]:
+               timeout: float = FETCH_TIMEOUT_S) -> Optional[dict]:
     """Pull the tracker's current skew digest (``skew`` wire command,
     same rendezvous protocol as ``topo``). Best-effort: returns None
     instead of raising — a tracker that predates the command, went
-    away, or has no digest yet just means no adaptation."""
+    away, or has no digest yet just means no adaptation. The default
+    timeout is deliberately tight: the only production caller is the
+    :class:`SkewMonitor` poller thread, and a wedged tracker must not
+    wedge the poller for whole seconds per attempt."""
     from ..tracker.tracker import MAGIC, _recv_str, _send_str, _send_u32
     from ..utils import retry
     try:
@@ -229,53 +323,111 @@ class SkewMonitor:
     Sources, strongest first: a forced ``RABIT_SKEW_DIGEST`` env digest
     (tests, CI smoke — deterministic, no tracker needed), then the
     tracker's ``skew`` command via ``RABIT_SKEW_TRACKER=host:port``
-    (exported by the engine at init), refreshed lazily at most every
-    ``rabit_skew_poll_ms``. Observations feed the EWMA estimator, whose
-    hysteretic laggard — not the raw digest's — drives adaptation."""
+    (exported by the engine at init), refreshed by a daemon poller
+    thread every ``rabit_skew_poll_ms`` — :meth:`current` only ever
+    reads the cache, so a slow or dead tracker can never stall a
+    dispatch behind a socket timeout (the poller itself backs off
+    ``BREAKER_BACKOFF``x after ``BREAKER_FAILURES`` straight misses).
+
+    The tracker's digest is applied VERBATIM — smoothing and the
+    hysteretic election are fleet-global, tracker-side state
+    (:class:`FleetElection`). Worker-side, :meth:`current` is still
+    only this process's *candidate*: what dispatch may act on is
+    :meth:`applied`, the digest the whole fleet adopted at the last
+    agreement boundary (``parallel/collectives._skew_sync_point``)."""
 
     def __init__(self):
-        self._est = SkewEstimator()
+        self._lock = threading.Lock()
         self._digest: Optional[dict] = None
         self._forced_raw: Optional[str] = None
-        self._next_fetch = 0.0
+        self._applied: Optional[dict] = None
+        self._synced = False
+        self._poller: Optional[threading.Thread] = None
+        self._stop = threading.Event()
 
     def observe(self, doc) -> Optional[dict]:
-        """Fold one digest into the smoothed view; returns the current
-        (smoothed) digest."""
+        """Cache one digest verbatim; returns the current candidate."""
         d = parse_digest(doc)
-        if d is not None:
-            self._est.update(d["offsets_ms"])
-            self._digest = {"epoch": d["epoch"],
-                            "offsets_ms": self._est.offsets_ms(),
-                            "laggard": (self._est.laggard
-                                        if d["laggard"] is not None
-                                        else None)}
-        return self._digest
+        with self._lock:
+            if d is not None:
+                self._digest = d
+            return self._digest
 
     def current(self) -> Optional[dict]:
+        """This process's candidate digest. Never blocks on a socket."""
         forced = os.environ.get(_DIGEST_ENV)
         if forced:
-            if forced != self._forced_raw:
-                self._forced_raw = forced
+            with self._lock:
+                changed = forced != self._forced_raw
+                if changed:
+                    self._forced_raw = forced
+            if changed:
                 try:
-                    self.observe(json.loads(forced))
+                    doc = json.loads(forced)
                 except ValueError:
-                    self._digest = None
+                    doc = None
+                with self._lock:
+                    self._digest = parse_digest(doc)
+            with self._lock:
+                return self._digest
+        with self._lock:
+            self._forced_raw = None
+        if ":" in os.environ.get(_TRACKER_ENV, ""):
+            self._ensure_poller()
+        with self._lock:
             return self._digest
-        self._forced_raw = None
-        addr = os.environ.get(_TRACKER_ENV, "")
-        if ":" in addr:
-            now = time.monotonic()
-            if now >= self._next_fetch:
-                self._next_fetch = now + poll_interval_s()
-                host, _, port = addr.rpartition(":")
-                try:
-                    d = fetch_skew(host, int(port))
-                except ValueError:
-                    d = None
-                if d is not None:
-                    self.observe(d)
-        return self._digest
+
+    def applied(self) -> Optional[dict]:
+        """The digest the fleet agreed to act on.
+
+        Before the first agreement boundary only a forced env digest is
+        eligible (identical on every process by the launch contract —
+        and reconciled anyway at the first boundary); a tracker-fetched
+        candidate is per-process opinion and must pass through the sync
+        broadcast before any dispatch may key a schedule on it."""
+        with self._lock:
+            if self._synced:
+                return self._applied
+        if os.environ.get(_DIGEST_ENV):
+            return self.current()
+        return None
+
+    def set_applied(self, digest: Optional[dict]) -> None:
+        """Adopt the fleet-agreed digest (sync boundaries only)."""
+        with self._lock:
+            self._applied = digest
+            self._synced = True
+
+    # -- background refresh ------------------------------------------------
+    def _ensure_poller(self) -> None:
+        with self._lock:
+            if self._poller is not None and self._poller.is_alive():
+                return
+            self._poller = threading.Thread(
+                target=self._poll_loop, name="rabit-skew-poll", daemon=True)
+            self._poller.start()
+
+    def _poll_loop(self) -> None:
+        misses = 0
+        while True:
+            interval = poll_interval_s()
+            if misses >= BREAKER_FAILURES:
+                interval *= BREAKER_BACKOFF
+            if self._stop.wait(interval):
+                return
+            addr = os.environ.get(_TRACKER_ENV, "")
+            if ":" not in addr:
+                continue
+            host, _, port = addr.rpartition(":")
+            try:
+                d = fetch_skew(host, int(port))
+            except ValueError:
+                d = None
+            if d is not None:
+                misses = 0
+                self.observe(d)
+            else:
+                misses += 1
 
 
 _monitor = SkewMonitor()
@@ -286,11 +438,85 @@ def monitor() -> SkewMonitor:
 
 
 def reset_monitor() -> None:
-    """Drop all smoothed state (tests; also correct after a recovery
-    epoch where ranks may have been reassigned)."""
-    global _monitor, _last_applied
+    """Drop all cached/agreed state (tests; also correct after a
+    recovery epoch where ranks may have been reassigned)."""
+    global _monitor, _last_applied, _dispatch_round
+    _monitor._stop.set()
     _monitor = SkewMonitor()
     _last_applied = None
+    _dispatch_round = 0
+
+
+# ------------------------------------------------------ agreement boundary
+#
+# Static schedule state (adapted method / groups) is a jit cache key in
+# multi-controller SPMD programs: all processes MUST derive it from the
+# same digest or they trace different collectives for the same round
+# and deadlock. The rendezvous is program order itself — every process
+# counts its adapt-enabled dispatches identically, so "counter hits a
+# sync_rounds boundary" fires on all of them at the same collective,
+# where parallel/collectives broadcasts process 0's candidate digest
+# over the device fabric and every process adopts the result.
+
+_dispatch_round = 0
+
+
+def sync_due() -> bool:
+    """Advance the dispatch counter; True when this dispatch is a fleet
+    agreement boundary (always true for the first adaptable dispatch
+    after a reset, so adaptation never acts on un-agreed state)."""
+    global _dispatch_round
+    due = _dispatch_round % sync_rounds() == 0
+    _dispatch_round += 1
+    return due
+
+
+def reset_sync() -> None:
+    """Re-arm the agreement boundary (world formation / recovery): a
+    re-formed world replays collectives from a common point, so every
+    process restarts the counter together, and the first dispatch of
+    the new epoch re-agrees before anything adapts. Rank assignments
+    may have changed, so the previously agreed digest is dropped."""
+    global _dispatch_round
+    _dispatch_round = 0
+    with _monitor._lock:
+        _monitor._applied = None
+        _monitor._synced = False
+
+
+# A digest rides the agreement broadcast as a flat vector of floats —
+# fixed shape, so the broadcast program itself is digest-independent.
+# Only the plan-relevant facts travel: validity, epoch, laggard, the
+# elected root, and the smoothed spread; decode re-synthesizes a
+# canonical two-entry digest for which laggard_of / earliest_of /
+# skew_ms_of reproduce the encoded elections exactly.
+SYNC_VEC_LEN = 5
+
+
+def encode_digest(digest: Optional[dict], world: int):
+    """Canonical digest -> length-``SYNC_VEC_LEN`` float tuple."""
+    d = parse_digest(digest)
+    if d is None:
+        return (0.0, 0.0, -1.0, -1.0, 0.0)
+    lag = d["laggard"]
+    root = earliest_of(d, world) if lag is not None else -1
+    return (1.0, float(d["epoch"]),
+            -1.0 if lag is None else float(lag),
+            float(root), max(skew_ms_of(d), 0.0))
+
+
+def decode_digest(vec) -> Optional[dict]:
+    """Inverse of :func:`encode_digest` (tolerates float32 transport)."""
+    vec = [float(v) for v in vec]
+    if len(vec) != SYNC_VEC_LEN or vec[0] < 0.5:
+        return None
+    epoch, lag, root = (int(round(v)) for v in vec[1:4])
+    if lag < 0:
+        return {"epoch": epoch, "offsets_ms": {}, "laggard": None}
+    offsets = {lag: max(vec[4], 0.0)}
+    if root >= 0 and root != lag:
+        offsets[root] = 0.0
+    return {"epoch": epoch, "offsets_ms": offsets, "laggard": lag}
 
 
 # The plan the most recent device_allreduce / device_hier_allreduce on
@@ -371,14 +597,24 @@ def demote_delegate(groups, laggard: int):
     return tuple(out)
 
 
-def preagg_groups(world: int, laggard: int):
+def preagg_groups(world: int, laggard: int, root: Optional[int] = None):
     """Membership encoding for the pre-aggregation schedule: the
-    arrived subgroup (flat order) and the laggard as a singleton —
-    hashable, so it rides the same static ``groups`` slot as the
-    rotations."""
+    arrived subgroup and the laggard as a singleton — hashable, so it
+    rides the same static ``groups`` slot as the rotations.
+
+    ``root`` (the elected earliest-arrival rank) is placed FIRST in the
+    early tuple: ``preagg_allreduce`` folds at ``early[0]``, so this is
+    where the election becomes load-bearing. Without ``root`` the early
+    tuple keeps flat order (``early[0]`` = lowest non-laggard rank)."""
     if not 0 <= laggard < world:
         raise ValueError(f"laggard {laggard} outside world {world}")
     early = tuple(r for r in range(world) if r != laggard)
+    if root is not None:
+        if root == laggard or not 0 <= root < world:
+            raise ValueError(
+                f"preagg root {root} must be a non-laggard rank inside "
+                f"world {world} (laggard {laggard})")
+        early = (root,) + tuple(r for r in early if r != root)
     return (early, (laggard,))
 
 
@@ -391,7 +627,9 @@ def adapt_plan(method: str, world: int, nbytes: int, op_name: str,
 
     - measured skew above ``rabit_skew_preagg_ms`` per MiB and a SUM
       payload -> ``preagg`` (early subgroup reduces while waiting, the
-      laggard's contribution folds in on arrival);
+      laggard's contribution folds in on arrival; the elected root
+      leads the early tuple, so ``preagg_allreduce``'s ``early[0]``
+      fold root IS the earliest-arrival rank);
     - ``tree`` -> ``tree_reroot``: laggard to a leaf, earliest arrival
       to the root (the XLA psum tree is rank-symmetric, so this records
       the election; the rooted fold inside ``preagg`` is where the root
@@ -412,7 +650,7 @@ def adapt_plan(method: str, world: int, nbytes: int, op_name: str,
             and skew_ms_of(digest) >= thresh * max(nbytes, 1) / (1 << 20)
             and method in ("tree", "ring", "bidir", "swing")):
         return dict(base, kind="preagg", method="preagg",
-                    groups=preagg_groups(world, lag))
+                    groups=preagg_groups(world, lag, root=root))
     if method == "tree":
         return dict(base, kind="tree_reroot", method="tree", groups=None)
     if method == "hier":

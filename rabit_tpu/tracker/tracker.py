@@ -36,12 +36,15 @@ Wire protocol (binary, little-endian, length-prefixed strings):
                    hierarchical collectives (parallel/topology.py).
     skew:          (no extra fields) tracker -> worker: payload str, a
                    JSON {"epoch","offsets_ms","laggard"} fleet skew
-                   digest derived from the poll loop's straggler
-                   snapshot (telemetry/skew.py) — per-rank mean arrival
+                   digest — the tracker-side FleetElection's smoothed,
+                   hysteretic verdict over the poll loop's straggler
+                   snapshots (telemetry/skew.py): per-rank EWMA arrival
                    offsets in ms plus the elected laggard (null while
-                   no rank crosses the signal threshold). "{}" until a
-                   poll sweep has per-rank busy times. Feeds the
-                   skew-adaptive schedules (rabit_skew_adapt).
+                   no rank crosses the signal threshold); epoch bumps
+                   exactly when the election changes. "{}" until a
+                   poll sweep has per-rank busy times. Workers cache it
+                   verbatim as their candidate and adopt it fleet-wide
+                   at agreement boundaries (rabit_skew_adapt).
   tracker -> worker (start/recover): rank u32, world u32, epoch u32,
     coord_host str, coord_port u32 (this epoch's tracker-hosted device
     -world coordination service; empty/0 when coordinator hosting is
@@ -210,8 +213,15 @@ class Tracker:
         self._topo: dict = {}
         # fleet skew digest {epoch, offsets_ms, laggard} (the ``skew``
         # wire command's payload, telemetry/skew.py); {} until the poll
-        # loop has a sweep with per-rank busy times to derive one from
+        # loop has a sweep with per-rank busy times to derive one from.
+        # The election (EWMA smoothing + laggard hysteresis) lives HERE
+        # — one FleetElection for the whole fleet — so every worker
+        # receives the same verdict and the digest's epoch bumps
+        # exactly when the election changes; workers apply it verbatim
+        # (per-process smoothing would diverge the static jit args the
+        # adapted schedules key on)
         self._skew: dict = {}
+        self._skew_election = None  # lazy: telemetry.skew.FleetElection
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Tracker":
@@ -397,7 +407,8 @@ class Tracker:
                                     key=lambda kv: int(kv[0]))]))
             gauges.append((
                 "rabit_skew_epoch",
-                "Topology epoch the current skew digest was derived in.",
+                "Fleet skew election epoch (bumps when the served "
+                "laggard verdict changes).",
                 "gauge", [({}, skew_doc.get("epoch", 0))]))
         return gauges
 
@@ -425,8 +436,13 @@ class Tracker:
                 summaries = dict(self._metrics)
                 self._poll_count += 1
             strag = crossrank.straggler_snapshot(summaries)
-            digest = skew.digest_from_snapshot(
-                strag, epoch=self._topo.get("epoch", 0))
+            # raw per-sweep offsets fold through the ONE fleet-wide
+            # election; the served digest is its smoothed, hysteretic
+            # verdict with an epoch that bumps on election change
+            raw = skew.digest_from_snapshot(strag)
+            if self._skew_election is None:
+                self._skew_election = skew.FleetElection()
+            digest = self._skew_election.fold(raw)
             with self._lock:
                 self._last_straggler = strag
                 if digest is not None:

@@ -22,6 +22,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
 import pytest
@@ -49,7 +50,8 @@ def skew_env(monkeypatch):
     both sides so one test's forced digest can't bleed into another."""
     for var in ("RABIT_SKEW_ADAPT", "RABIT_SKEW_DIGEST",
                 "RABIT_SKEW_PREAGG_MS", "RABIT_SKEW_POLL_MS",
-                "RABIT_SKEW_TRACKER", "RABIT_HIER", "RABIT_HIER_GROUP",
+                "RABIT_SKEW_SYNC_ROUNDS", "RABIT_SKEW_TRACKER",
+                "RABIT_HIER", "RABIT_HIER_GROUP",
                 "RABIT_DATAPLANE_WIRE"):
         monkeypatch.delenv(var, raising=False)
     monkeypatch.setenv("RABIT_DISPATCH_TABLE", "none")
@@ -123,6 +125,48 @@ def test_estimator_rejects_bad_alpha():
         skew.SkewEstimator(alpha=0.0)
     with pytest.raises(ValueError, match="alpha"):
         skew.SkewEstimator(alpha=1.5)
+
+
+# ------------------------------------------------------ fleet election
+
+
+def test_fleet_election_epoch_bumps_only_on_change():
+    """The tracker-side election: epoch identifies the verdict, so it
+    must bump exactly when the served laggard changes — a stable
+    election keeps workers' jit cache keys stable."""
+    el = skew.FleetElection(alpha=1.0, hysteresis_ms=5.0)
+    assert el.fold(None) is None  # nothing ever folded: nothing served
+    d1 = el.fold({"epoch": 0, "offsets_ms": {"0": 0.0, "1": 30.0},
+                  "laggard": 1})
+    assert d1["laggard"] == 1 and d1["epoch"] == 1
+    # same verdict, fresher offsets: epoch must NOT move
+    d2 = el.fold({"epoch": 0, "offsets_ms": {"0": 0.0, "1": 31.0},
+                  "laggard": 1})
+    assert d2["laggard"] == 1 and d2["epoch"] == 1
+    # election flips (decisively past hysteresis): epoch bumps
+    d3 = el.fold({"epoch": 0, "offsets_ms": {"0": 50.0, "1": 0.0},
+                  "laggard": 0})
+    assert d3["laggard"] == 0 and d3["epoch"] == 2
+    # a tie sweep suppresses the accusation and that IS a new verdict
+    d4 = el.fold({"epoch": 0, "offsets_ms": {"0": 50.0, "1": 0.0},
+                  "laggard": None})
+    assert d4["laggard"] is None and d4["epoch"] == 3
+    # between sweeps the last served digest keeps being served
+    assert el.fold(None) == d4
+
+
+def test_fleet_election_smooths_and_holds_through_noise():
+    """EWMA + hysteresis live fleet-side: a couple of noisy sweeps must
+    not flip the served election (worker-side there is no smoothing at
+    all — every process must see the same verdict)."""
+    el = skew.FleetElection()
+    for _ in range(10):
+        d = el.fold({"epoch": 0, "offsets_ms": {"0": 0.0, "1": 30.0,
+                                                "2": 0.0}, "laggard": 1})
+    for _ in range(2):
+        d = el.fold({"epoch": 0, "offsets_ms": {"0": 0.0, "1": 30.0,
+                                                "2": 45.0}, "laggard": 2})
+    assert d["laggard"] == 1 and d["epoch"] == 1
 
 
 # -------------------------------------------------------------- digest
@@ -213,6 +257,117 @@ def test_monitor_forced_digest_and_note_applied(skew_env):
     assert skew.monitor().current() is not None  # env still forces one
 
 
+def test_monitor_tracker_candidate_gated_until_agreement(skew_env):
+    """A tracker-fed digest is this process's OPINION, not fleet state:
+    applied() must withhold it until a sync boundary adopts it, or each
+    process would key static jit args on its own independently-timed
+    fetch (the multi-controller deadlock the agreement plane exists to
+    prevent)."""
+    mon = skew.monitor()
+    cand = mon.observe({"epoch": 3, "offsets_ms": {"0": 0.0, "1": 40.0},
+                        "laggard": 1})
+    assert skew.laggard_of(mon.current()) == 1  # candidate visible...
+    assert mon.applied() is None                # ...but not actionable
+    mon.set_applied(mon.current())              # the agreement boundary
+    assert mon.applied() == cand
+    skew.reset_sync()  # world re-forms: agreed state must drop
+    assert mon.applied() is None
+    assert skew.laggard_of(mon.current()) == 1  # candidate survives
+
+
+def test_monitor_forced_digest_eligible_before_first_sync(skew_env):
+    """RABIT_SKEW_DIGEST is identical on every process by the launch
+    contract, so it may apply before any boundary; once a boundary
+    runs, its verdict wins outright."""
+    _force_digest(skew_env, {"0": 0.0, "1": 25.0}, 1)
+    mon = skew.monitor()
+    assert skew.laggard_of(mon.applied()) == 1
+    mon.set_applied(None)  # a boundary agreed on "no adaptation"
+    assert mon.applied() is None
+
+
+def test_sync_due_fires_on_round_boundaries(skew_env):
+    skew_env.setenv("RABIT_SKEW_SYNC_ROUNDS", "4")
+    fires = [skew.sync_due() for _ in range(9)]
+    assert fires == [True, False, False, False,
+                     True, False, False, False, True]
+    # a re-formed world restarts the cadence: first dispatch re-agrees
+    skew.reset_sync()
+    assert skew.sync_due() is True
+
+
+def test_sync_rounds_knob_floor_and_validation(skew_env):
+    assert skew.sync_rounds() == skew.SYNC_ROUNDS_DEFAULT
+    skew_env.setenv("RABIT_SKEW_SYNC_ROUNDS", "0")
+    assert skew.sync_rounds() == 1
+    skew_env.setenv("RABIT_SKEW_SYNC_ROUNDS", "soon")
+    with pytest.raises(ValueError, match="RABIT_SKEW_SYNC_ROUNDS"):
+        skew.sync_rounds()
+
+
+@pytest.mark.parametrize("world", [2, 4, 8])
+def test_sync_vector_roundtrip_preserves_elections(world):
+    """The 5-float agreement vector must reproduce every election the
+    schedule keys on — laggard, earliest-arrival root, and the spread
+    preagg gates on — through a float32 round-trip."""
+    for lag in range(world):
+        d = {"epoch": 7, "laggard": lag,
+             "offsets_ms": {str(r): (80.0 if r == lag else float(r))
+                            for r in range(world)}}
+        vec = np.asarray(skew.encode_digest(d, world), np.float32)
+        rt = skew.decode_digest(vec)
+        parsed = skew.parse_digest(d)
+        assert rt["epoch"] == 7
+        assert skew.laggard_of(rt) == lag
+        assert skew.earliest_of(rt, world) == skew.earliest_of(parsed,
+                                                               world)
+        assert skew.skew_ms_of(rt) == pytest.approx(
+            skew.skew_ms_of(parsed), abs=1e-3)
+
+
+def test_sync_vector_roundtrip_none_and_tie():
+    assert skew.decode_digest(skew.encode_digest(None, 4)) is None
+    tie = {"epoch": 2, "offsets_ms": {"0": 1.0, "1": 1.0},
+           "laggard": None}
+    rt = skew.decode_digest(skew.encode_digest(tie, 4))
+    assert rt["epoch"] == 2 and rt["laggard"] is None
+    assert skew.decode_digest([1.0, 1.0, 0.0]) is None  # wrong length
+
+
+def test_monitor_never_blocks_on_dead_tracker(skew_env):
+    """REVIEW medium: the dispatch path must not eat a socket timeout
+    when the tracker is dead — current() only reads the cache and the
+    poller thread absorbs the misses."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    skew_env.setenv("RABIT_SKEW_TRACKER", f"127.0.0.1:{port}")
+    skew.reset_monitor()
+    t0 = time.monotonic()
+    for _ in range(20):
+        assert skew.monitor().current() is None
+    assert time.monotonic() - t0 < 1.0, "current() blocked on a socket"
+
+
+def test_monitor_background_poller_picks_up_digest(skew_env):
+    tr = Tracker(1, ready_timeout=5.0).start()
+    try:
+        with tr._lock:
+            tr._skew = {"epoch": 2, "offsets_ms": {"0": 0.0, "1": 9.0},
+                        "laggard": 1}
+        skew_env.setenv("RABIT_SKEW_TRACKER", f"{tr.host}:{tr.port}")
+        skew_env.setenv("RABIT_SKEW_POLL_MS", "100")
+        skew.reset_monitor()
+        deadline = time.monotonic() + 10.0
+        got = skew.monitor().current()  # arms the poller, reads cache
+        while got is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+            got = skew.monitor().current()
+        assert skew.laggard_of(got) == 1
+    finally:
+        tr.stop()
+
+
 # --------------------------------------------- plans: permutation property
 
 
@@ -238,6 +393,40 @@ def test_preagg_groups_partition(world):
         assert single == (lag,)
         assert _is_permutation((early, single), world)
         assert list(early) == sorted(early)  # flat order preserved
+
+
+@pytest.mark.parametrize("world", range(3, 9))
+def test_preagg_groups_places_elected_root_first(world):
+    """REVIEW low: preagg_allreduce folds at ``early[0]``, so the
+    elected root must LEAD the early tuple — encoding it anywhere else
+    silently reverts the election to flat order."""
+    for lag in range(world):
+        for root in range(world):
+            if root == lag:
+                with pytest.raises(ValueError, match="root"):
+                    skew.preagg_groups(world, lag, root=root)
+                continue
+            early, late = skew.preagg_groups(world, lag, root=root)
+            assert early[0] == root
+            assert late == (lag,)
+            assert _is_permutation((early, late), world)
+    with pytest.raises(ValueError, match="root"):
+        skew.preagg_groups(world, 0, root=world)
+
+
+def test_adapt_plan_preagg_elected_root_leads_early_group(skew_env):
+    """The earliest-arrival election must reach the fold: the plan's
+    root and ``groups[0][0]`` agree even when the earliest rank is not
+    the lowest-numbered one."""
+    skew_env.setenv("RABIT_SKEW_PREAGG_MS", "0.0001")
+    digest = skew.parse_digest(
+        {"epoch": 1, "laggard": 1,
+         "offsets_ms": {"0": 5.0, "1": 30.0, "2": 0.0, "3": 6.0}})
+    plan = skew.adapt_plan("ring", 4, 4096, "sum", digest=digest)
+    assert plan["kind"] == "preagg"
+    assert plan["root"] == 2
+    assert plan["groups"][0][0] == plan["root"]
+    assert plan["groups"] == ((2, 0, 3), (1,))
 
 
 def test_demote_delegate_moves_laggard_to_tail_only():
@@ -389,6 +578,27 @@ def test_resolve_no_provenance_when_knob_off(skew_env):
         telemetry.reset(enabled=False)
 
 
+def test_resolve_no_provenance_for_out_of_world_laggard(skew_env):
+    """REVIEW low: a digest naming a laggard outside this world (stale
+    after a resize, or another mesh's verdict) adapts nothing —
+    resolve must not stamp skew_adapted for a plan that cannot
+    apply."""
+    skew_env.setenv("RABIT_SKEW_ADAPT", "1")
+    _force_digest(skew_env, {"0": 0.0, "7": 90.0}, 7)
+    telemetry.reset(capacity=64, enabled=True)
+    try:
+        f32 = np.dtype(np.float32)
+        dispatch.resolve(10**6, f32, SUM, 4)
+        dispatch.resolve(100, f32, SUM, 4, method="auto")
+        snap = telemetry.snapshot()
+        assert all(c.get("provenance") != "skew_adapted"
+                   for c in snap["counters"]), snap["counters"]
+        assert not any(c["name"] == "dispatch.skew_adapted"
+                       for c in snap["counters"])
+    finally:
+        telemetry.reset(enabled=False)
+
+
 def test_resolve_enabled_without_digest_is_unadapted(skew_env):
     skew_env.setenv("RABIT_SKEW_ADAPT", "1")
     telemetry.reset(capacity=64, enabled=True)
@@ -485,6 +695,46 @@ def test_auto_adapted_span_attribute(skew_env):
                    for c in snap["counters"])
     finally:
         telemetry.reset(enabled=False)
+
+
+@needs_mesh
+def test_device_path_adopts_candidate_only_at_boundary(skew_env):
+    """Agreement discipline on the device path: dispatch acts on the
+    digest ADOPTED at the last sync boundary, not the live candidate —
+    a fresher tracker fetch mid-window must not flip the schedule until
+    the next boundary (static jit args may only change in fleet
+    lockstep)."""
+    skew_env.setenv("RABIT_SKEW_ADAPT", "1")
+    skew_env.setenv("RABIT_SKEW_PREAGG_MS", "0")  # isolate rotation
+    skew_env.setenv("RABIT_SKEW_SYNC_ROUNDS", "1000")
+    mesh = make_mesh(4)
+    per_rank = np.tile(np.arange(32, dtype=np.int32), (4, 1))
+    want = np.arange(32) * 4
+
+    def digest_naming(lag, epoch):
+        return {"epoch": epoch, "laggard": lag,
+                "offsets_ms": {str(r): (45.0 if r == lag else 0.0)
+                               for r in range(4)}}
+
+    mon = skew.monitor()
+    mon.observe(digest_naming(2, 1))
+    assert mon.applied() is None  # candidate awaits the first boundary
+    out = np.asarray(device_allreduce(shard_over(mesh, per_rank),
+                                      mesh, SUM, method="ring"))
+    np.testing.assert_array_equal(out, want)
+    assert skew.last_applied() == "rotate@2"  # dispatch 0 IS a boundary
+    # a fresher candidate inside the window: the schedule must hold
+    mon.observe(digest_naming(3, 2))
+    out = np.asarray(device_allreduce(shard_over(mesh, per_rank),
+                                      mesh, SUM, method="ring"))
+    np.testing.assert_array_equal(out, want)
+    assert skew.last_applied() == "rotate@2"
+    # world re-forms -> next dispatch re-agrees -> new election lands
+    skew.reset_sync()
+    out = np.asarray(device_allreduce(shard_over(mesh, per_rank),
+                                      mesh, SUM, method="ring"))
+    np.testing.assert_array_equal(out, want)
+    assert skew.last_applied() == "rotate@3"
 
 
 @needs_mesh

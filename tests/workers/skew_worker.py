@@ -3,12 +3,18 @@ sleeping before every collective (an injected arrival straggler).
 
 argv: <process_id> <num_processes> <coordinator_port>
 
-Two phases:
+Three phases:
 
 1. correctness — the adapted schedules (rotation via the forced
    digest; explicit pre-aggregation) must be BIT-exact against the
    flat ring on integer-valued payloads for every dtype (association-
    free, so any dropped/duplicated contribution shows up);
+1b. agreement — each process forces a DIVERGENT candidate digest
+   (accusing itself); the sync boundary must reconcile every rank
+   onto process 0's candidate, so the whole fleet adapts around
+   laggard 0. Per-process application of divergent candidates — the
+   bug this phase pins — traced different static schedules per rank
+   and deadlocked;
 2. performance — mean fleet round time over a lagging fleet must be
    LOWER with ``rabit_skew_adapt=1`` (pre-aggregation overlaps the
    early ranks' reduction with the laggard's delay) than with the
@@ -115,6 +121,31 @@ def main() -> None:
         assert pre.dtype == flat.dtype and np.array_equal(pre, flat), \
             (r, dt, pre[:4])
         _assert_ranks_identical(pre, r)
+    _set_adapt(False, w, "0")
+
+    # ---- phase 1b: divergent candidates. Each process forces a digest
+    # accusing ITSELF — maximally divergent per-process opinions. The
+    # agreement boundary must reconcile the fleet onto process 0's
+    # candidate before anything becomes a static jit argument; the old
+    # per-process application deadlocked here (each rank traced a
+    # different rotation for the same round).
+    os.environ["RABIT_SKEW_ADAPT"] = "1"
+    os.environ["RABIT_SKEW_PREAGG_MS"] = "0"
+    os.environ["RABIT_SKEW_DIGEST"] = json.dumps(
+        {"epoch": 2, "laggard": r,
+         "offsets_ms": {str(i): (80.0 if i == r else 0.0)
+                        for i in range(w)}})
+    skew.reset_monitor()
+    arr = (base + r).astype(np.int32)
+    got = rabit.allreduce(arr, rabit.SUM)
+    want = (base * w + sum(range(w))).astype(np.int32)
+    assert np.array_equal(got, want), (r, got[:4])
+    # whatever schedule family dispatch elects, the laggard it adapts
+    # around must be the AGREED one (process 0's candidate), not this
+    # process's own accusation
+    applied = skew.last_applied()
+    assert applied is not None and applied.endswith("@0"), (r, applied)
+    _assert_ranks_identical(got, r)
     _set_adapt(False, w, "0")
 
     # ---- phase 2: lagging fleet, mean round time with/without adapt
