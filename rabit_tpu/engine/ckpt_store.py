@@ -104,6 +104,13 @@ class CheckpointStore:
         self.rank = int(rank)
         self.keep = max(1, int(keep))
         self.dir = os.path.join(root, f"r{self.rank}")
+        # resize protection (ISSUE 9 satellite): the newest version
+        # written at the OLD world size is pinned across an elastic
+        # resize until the new world commits its first checkpoint —
+        # without the pin, `keep` new-world saves on a fast rank can
+        # prune the only version a slower rank still shares, and a
+        # subsequent cold restart has no common version to agree on.
+        self._protected: Optional[int] = None
         os.makedirs(self.dir, exist_ok=True)
 
     # -- paths ------------------------------------------------------------
@@ -141,6 +148,9 @@ class CheckpointStore:
             os.close(fd)
         os.replace(tmp, final)
         self._fsync_dir()
+        # a durable post-resize save IS the new world's first committed
+        # checkpoint: the old-world pin has served its purpose
+        self._protected = None
         self.prune()
         return final
 
@@ -158,15 +168,74 @@ class CheckpointStore:
 
     def prune(self) -> List[int]:
         """Drop all but the newest ``keep`` versions; returns what was
-        removed. Never removes the file it cannot list past."""
+        removed. Never removes the file it cannot list past, and never
+        the version pinned by :meth:`protect_current` (the newest
+        old-world checkpoint of an in-flight elastic resize)."""
         vs = self.versions()
         doomed = vs[:-self.keep] if len(vs) > self.keep else []
+        doomed = [v for v in doomed if v != self._protected]
         for v in doomed:
             try:
                 os.unlink(self.path_for(v))
             except OSError:
                 pass
         return doomed
+
+    def protect_current(self) -> Optional[int]:
+        """Pin the newest stored version against pruning until the
+        next :meth:`save` lands (engines call this when the world
+        resizes: ``rabit_ckpt_keep`` must not drop the newest version
+        written at the old world size while the new world has not yet
+        committed its first checkpoint). Returns the pinned version,
+        or None when the store is empty."""
+        vs = self.versions()
+        self._protected = vs[-1] if vs else None
+        return self._protected
+
+    @property
+    def protected_version(self) -> Optional[int]:
+        return self._protected
+
+    # -- elastic shard redistribution -------------------------------------
+    def adopt_latest_from_peers(self) -> Optional[int]:
+        """Seed this rank's directory from a sibling rank's shards: a
+        joiner re-admitted into an elastic world may have an empty (or
+        stale) store while the survivors' newest version moved on. The
+        global payload is world-replicated, so any sibling's newest
+        intact record is a valid seed — REDISTRIBUTED from the durable
+        store, not replayed from scratch. Copies only when a sibling
+        holds a strictly newer version; the adopted version is
+        immediately pinned (see :meth:`protect_current`). Returns the
+        adopted version, or None when nothing newer exists."""
+        mine = self.latest_version()
+        best: Optional[Tuple[int, "CheckpointStore"]] = None
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return None
+        for name in sorted(names):
+            if not name.startswith("r") or name == f"r{self.rank}":
+                continue
+            try:
+                peer_rank = int(name[1:])
+            except ValueError:
+                continue
+            peer = CheckpointStore(self.root, peer_rank, keep=self.keep)
+            v = peer.latest_version()
+            if v > mine and (best is None or v > best[0]):
+                best = (v, peer)
+        if best is None:
+            return None
+        version, peer = best
+        got = peer.load(version)
+        if got is None:
+            return None
+        self.save(version, got[0], got[1])
+        self._protected = version
+        log.log_warn("ckpt_store: rank %d adopted v%d from rank %d "
+                     "(elastic shard redistribution)", self.rank,
+                     version, peer.rank)
+        return version
 
     # -- read -------------------------------------------------------------
     def load(self, version: int) -> Optional[Tuple[bytes, bytes]]:

@@ -362,6 +362,35 @@ class NativeEngine(Engine):
             raise RuntimeError("no data plane registered")
         self._dataplane.on_world_reformed = fn
 
+    def epoch_reset(self, world: int) -> None:
+        """Elastic-membership epoch hook (lint rule R002): an elastic
+        tracker re-formed the world at a new size, so drop everything
+        keyed on the old one — the skew plane's agreed digest and
+        dispatch counter, the exported host grouping (its ranks are
+        old-world names), the dispatch table cache, the tracker
+        membership monitor's formed baseline — and pin the newest
+        old-world checkpoint against pruning until the resized world
+        commits its own (a re-admitted joiner additionally seeds its
+        store from its siblings' durable shards)."""
+        from ..parallel import dispatch as _dispatch
+        from ..parallel import topology as _topology
+        from ..telemetry import flight as _fl
+        from ..telemetry import skew as _skew
+        from ..tracker import membership as _membership
+        world = int(world)
+        _topology.epoch_reset(world)
+        _dispatch.epoch_reset(world)
+        _skew.epoch_reset(world)
+        _membership.epoch_reset(world)
+        if self._store is not None:
+            self._store.protect_current()
+            self._store.adopt_latest_from_peers()
+        telemetry.count("membership.epoch_reset",
+                        provenance="membership")
+        telemetry.record_span("membership.transition", 0.0, op="resize",
+                              provenance="membership", world=world)
+        _fl.note("member_resize", f"world resized to {world}")
+
     def shutdown(self) -> None:
         if self._metrics_server is not None:
             self._metrics_server.stop()

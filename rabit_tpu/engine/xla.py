@@ -190,6 +190,41 @@ class XlaEngine(Engine):
                              self._watchdog.ms_per_mb) * self._hier_scale
         return self._watchdog.guard(name, nbytes=nbytes, deadline_s=d)
 
+    def epoch_reset(self, world: int) -> None:
+        """Elastic-membership epoch hook (lint rule R002): adopt a
+        resized world and drop every piece of state derived from the
+        old one. For this engine a resize always arrives through a
+        fresh registration (the JAX distributed client is bound to one
+        coordination service per process lifetime), so the hook's job
+        is the state that OUTLIVES registration: host grouping, the
+        skew plane's agreed digest and dispatch counter, the dispatch
+        table cache, and the checkpoint store — whose newest old-world
+        version is pinned against pruning until the new world commits
+        its first checkpoint, and which a re-admitted joiner seeds
+        from its siblings' durable shards."""
+        from ..parallel import dispatch as _dispatch
+        from ..parallel import topology as _topology
+        from ..telemetry import flight as _fl
+        from ..telemetry import skew as _skew
+        from ..tracker import membership as _membership
+        world = int(world)
+        old, self._world = self._world, world
+        _topology.epoch_reset(world)
+        _dispatch.epoch_reset(world)
+        _skew.epoch_reset(world)
+        _membership.epoch_reset(world)
+        self._groups = _topology.resolve_groups(world)
+        log.set_identity(self._rank, world)
+        if self._store is not None:
+            self._store.protect_current()
+            self._store.adopt_latest_from_peers()
+        telemetry.count("membership.epoch_reset",
+                        provenance="membership")
+        telemetry.record_span("membership.transition", 0.0, op="resize",
+                              provenance="membership", old_world=old,
+                              world=world)
+        _fl.note("member_resize", f"world {old} -> {world}")
+
     def shutdown(self) -> None:
         if self._metrics_server is not None:
             self._metrics_server.stop()

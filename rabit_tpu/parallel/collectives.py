@@ -920,6 +920,29 @@ def _skew_sync_point(mesh: Mesh, axis: str) -> None:
     telemetry.count("dispatch.skew_sync")
 
 
+def _rotation_for(mesh: Mesh, axis: str, world: int):
+    """Skew adaptation for the RS/AG primitives: ``(order, adapted)``.
+
+    Rotation is the ONLY plan these schedules admit (there is no tree
+    to re-root and nothing to pre-aggregate — the payload is already
+    the substrate), so this reads the fleet-AGREED digest directly
+    instead of going through :func:`skew.adapt_plan`'s method-switching
+    logic: laggard named and inside this world -> walk the ring in
+    laggard-last order; anything else -> ``(None, None)``, which keeps
+    the traced program byte-identical to the unadapted one."""
+    if not _skew.adapt_enabled() or world < 2:
+        return None, None
+    _skew_sync_point(mesh, axis)
+    lag = _skew.laggard_of(_skew.monitor().applied())
+    if lag is None or not 0 <= lag < world:
+        _skew.note_applied(None)
+        return None, None
+    adapted = f"rotate@{lag}"
+    _skew.note_applied(adapted)
+    telemetry.count("dispatch.skew_adapted")
+    return _skew.rotation_order(world, lag), adapted
+
+
 def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
                      axis: Optional[str] = None,
                      method: str = "auto",
@@ -1007,13 +1030,25 @@ def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "wire"))
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "op", "wire",
+                                             "order"))
 def _reduce_scatter_global(xs, mesh: Mesh, axis: str, op: int,
-                           wire: str | None = None):
+                           wire: str | None = None, order=None):
     def per_shard(x):
         flat = x.reshape(-1)  # drop the per-device leading 1
         with telemetry.trace_annotation("rabit_reduce_scatter"):
-            return ring_reduce_scatter(flat, axis, op, wire=wire)
+            if order is None:
+                return ring_reduce_scatter(flat, axis, op, wire=wire)
+            # laggard-last rotation: walk the ring in ``order`` (a
+            # static permutation of the axis) so the laggard owns the
+            # final position of every chunk walk. Grouped RS lands
+            # ownership on the LOCAL ring index, so pre-permuting the
+            # input chunks by the same order keeps the contract that
+            # rank i ends owning chunk i of the ORIGINAL layout.
+            chunks = flat.reshape(len(order), -1)
+            rot = jnp.concatenate([chunks[r] for r in order])
+            return ring_reduce_scatter(rot, axis, op, wire=wire,
+                                       groups=(order,))
     return unchecked_shard_map(per_shard, mesh=mesh, in_specs=P(axis),
                                out_specs=P(axis))(xs)
 
@@ -1046,28 +1081,45 @@ def device_reduce_scatter(xs: jax.Array, mesh: Mesh, op: int = SUM,
             "input or use device_allreduce")
     wire = None if wire in (None, "none", "auto") else wire
     wire = _normalize_wire(wire, op, xs.dtype, n // p)
+    order, adapted = _rotation_for(mesh, axis, p)
     cost = _profile.record_cost("reduce_scatter", "ring", wire, n,
                                 xs.dtype.itemsize, p, phase="rs")
     extra = ({"cost_flops": cost["flops"],
               "cost_wire_bytes": cost["wire_bytes"],
               "cost_hops": cost["hops"]} if cost else {})
+    if adapted:
+        extra["adapted"] = adapted
     sp = telemetry.span("reduce_scatter", nbytes=n * xs.dtype.itemsize,
                         op=OP_NAMES.get(op, str(op)), method="ring",
                         wire=wire, **extra)
     with sp:
         with _profile.jit_probe("reduce_scatter", _reduce_scatter_global):
-            out = _reduce_scatter_global(xs, mesh, axis, op, wire)
+            out = _reduce_scatter_global(xs, mesh, axis, op, wire, order)
         if sp.live:
             out.block_until_ready()
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
-def _allgather_global(xs, mesh: Mesh, axis: str):
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "order"))
+def _allgather_global(xs, mesh: Mesh, axis: str, order=None):
     def per_shard(x):
         flat = x.reshape(-1)  # drop the per-device leading 1
         with telemetry.trace_annotation("rabit_allgather"):
-            return ring_all_gather(flat, axis)
+            if order is None:
+                return ring_all_gather(flat, axis)
+            # laggard-last rotation: gather around the reordered ring
+            # (the laggard's chunk enters last), then restore the
+            # rank-order concatenation the contract promises — grouped
+            # AG concatenates in GROUP order, so the inverse
+            # permutation puts chunk of rank order[j] back at slot
+            # order[j].
+            gathered = ring_all_gather(flat, axis, groups=(order,))
+            chunks = gathered.reshape(len(order), -1)
+            inv = [0] * len(order)
+            for j, r in enumerate(order):
+                inv[r] = j
+            return jnp.concatenate([chunks[inv[i]]
+                                    for i in range(len(order))])
     return unchecked_shard_map(per_shard, mesh=mesh, in_specs=P(axis),
                                out_specs=P())(xs)
 
@@ -1085,16 +1137,19 @@ def device_allgather(xs: jax.Array, mesh: Mesh,
     p = mesh.shape[axis]
     m = int(np.prod(xs.shape[1:]))
     n = p * m
+    order, adapted = _rotation_for(mesh, axis, p)
     cost = _profile.record_cost("allgather", "ring", None, n,
                                 xs.dtype.itemsize, p, phase="ag")
     extra = ({"cost_flops": cost["flops"],
               "cost_wire_bytes": cost["wire_bytes"],
               "cost_hops": cost["hops"]} if cost else {})
+    if adapted:
+        extra["adapted"] = adapted
     sp = telemetry.span("allgather", nbytes=n * xs.dtype.itemsize,
                         method="ring", **extra)
     with sp:
         with _profile.jit_probe("allgather", _allgather_global):
-            out = _allgather_global(xs, mesh, axis)
+            out = _allgather_global(xs, mesh, axis, order)
         if sp.live:
             out.block_until_ready()
     return out

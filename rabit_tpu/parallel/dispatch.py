@@ -110,6 +110,16 @@ def clear_cache() -> None:
     _cache.clear()
 
 
+def epoch_reset(world: int) -> None:
+    """Elastic-membership epoch hook (lint rule R002): the parsed
+    dispatch table is cached per path, and its rows steer method
+    choice per axis size — after a resize the relevant axis size
+    changed, so a stale parse must not outlive the world that loaded
+    it (re-parsing one JSON file per epoch transition is free)."""
+    del world  # resolve() receives the new axis size per call
+    clear_cache()
+
+
 def load_table(path: Optional[str] = None) -> Optional[dict]:
     """The committed dispatch table, or None (→ fallback constants).
 

@@ -159,6 +159,24 @@ def groups_spec(groups) -> str:
     return "|".join(",".join(str(r) for r in grp) for grp in groups)
 
 
+def epoch_reset(world: int) -> None:
+    """Elastic-membership epoch hook (lint rule R002). The grouping
+    exported through ``RABIT_HIER_GROUP`` names OLD-world ranks; after
+    a resize it may not even parse for the new world (a rank beyond
+    ``world``, a partition that no longer covers it). Drop it unless it
+    still describes the new world exactly — the engine re-exports a
+    fresh tracker-discovered grouping when the re-formed assignment
+    arrives, so a dropped spec means "flat until rediscovered", never
+    a crash on the survivors' first post-resize collective."""
+    spec = os.environ.get(_GROUP_ENV)
+    if not spec:
+        return
+    try:
+        parse_groups(spec, int(world))
+    except (ValueError, TypeError):
+        os.environ.pop(_GROUP_ENV, None)
+
+
 def group_by_fingerprint(fingerprints: Sequence[str]) -> Groups:
     """Group ranks sharing a host fingerprint (``fingerprints[rank]``),
     preserving rank order within each group and first-appearance order

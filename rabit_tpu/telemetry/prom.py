@@ -73,6 +73,10 @@ METRIC_FAMILIES = (
     "rabit_straggler_busy_skew_seconds",
     "rabit_skew_offset_ms",
     "rabit_skew_epoch",
+    # elastic membership (tracker/tracker.py, ISSUE 9)
+    "rabit_world_size",
+    "rabit_member_evictions_total",
+    "rabit_member_admissions_total",
 )
 
 

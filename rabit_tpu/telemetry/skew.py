@@ -242,6 +242,25 @@ class FleetElection:
                                self._est.offsets_ms().items()},
                 "laggard": self._laggard}
 
+    def evict(self, rank: int) -> None:
+        """Forget an evicted rank (elastic membership): its smoothed
+        offset must not haunt the next world's election, and a served
+        digest naming a rank that no longer exists would rotate the
+        survivors around a ghost. Bumps the epoch when the served
+        laggard WAS the evicted rank, so workers see the retraction as
+        an ordinary election change."""
+        rank = int(rank)
+        ewma = self._est._ewma
+        ewma.pop(rank, None)
+        if self._est._laggard == rank:
+            # immediate re-election, no hysteresis: the incumbent did
+            # not lose a contest, it left the world
+            self._est._laggard = (max(ewma, key=ewma.get)
+                                  if ewma else None)
+        if self._laggard == rank and self._epoch > 0:
+            self._laggard = self._est._laggard
+            self._epoch += 1
+
 
 # ----------------------------------------------------------------- digest
 
@@ -482,6 +501,20 @@ def reset_sync() -> None:
     with _monitor._lock:
         _monitor._applied = None
         _monitor._synced = False
+
+
+def epoch_reset(world: int) -> None:
+    """Elastic-membership epoch hook (lint rule R002): every module
+    holding world-size-derived state must drop it when the registration
+    epoch changes. For the skew plane that is the cached/agreed digest
+    (its laggard and offsets are OLD-world ranks — a rotation keyed on
+    them would permute the new world around a ghost), the applied tag,
+    and the dispatch counter that defines the agreement rendezvous."""
+    del world  # only the fact of the transition matters here
+    reset_sync()
+    note_applied(None)
+    with _monitor._lock:
+        _monitor._digest = None
 
 
 # A digest rides the agreement broadcast as a flat vector of floats —

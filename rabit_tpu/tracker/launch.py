@@ -77,7 +77,8 @@ class _ChaosFarm:
 def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
            timeout: float = 300.0, quiet: bool = False,
            coordinator: Optional[bool] = None,
-           stats: Optional[Dict] = None, chaos=None) -> int:
+           stats: Optional[Dict] = None, chaos=None,
+           elastic: Optional[bool] = None) -> int:
     """Run ``cmd`` as ``nworkers`` local processes under a tracker.
     Returns 0 on success. Workers exiting nonzero are respawned with an
     incremented attempt counter until ``max_attempts``. ``coordinator``
@@ -95,21 +96,36 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
     tracker rewrites advertised peer addresses through per-link proxies
     — so scheduled delays/resets/partitions/blackouts hit live
     registration and collective traffic (doc/fault_tolerance.md)."""
+    from . import membership as _membership
     if coordinator is None:
         coordinator = (os.environ.get("RABIT_DATAPLANE") == "xla"
                        or any(a == "rabit_dataplane=xla" for a in cmd))
     if chaos is None:
         chaos = os.environ.get("RABIT_CHAOS") or None
+    if elastic is None:
+        elastic = (_membership.elastic_enabled()
+                   or any(a == "rabit_elastic=1" for a in cmd))
     farm = _ChaosFarm(chaos) if chaos is not None else None
     tracker = Tracker(
         nworkers, coordinator=coordinator,
-        link_rewrite=farm.link_rewrite if farm else None).start()
+        link_rewrite=farm.link_rewrite if farm else None,
+        elastic=elastic).start()
     tracker_addr = (tracker.host, tracker.port)
     if farm is not None:
         proxy = farm.front_tracker(tracker)
         tracker_addr = (proxy.host, proxy.port)
     procs: Dict[int, subprocess.Popen] = {}
+    # respawn accounting is PER RANK: `attempts[i]` counts every spawn
+    # of worker i (exported as RABIT_NUM_TRIAL so mock kill schedules
+    # advance), while `faults[i]` counts only the deaths that consume
+    # the `max_attempts` budget — one flapping rank can exhaust its OWN
+    # budget but never a healthy neighbour's. Elastic re-admissions are
+    # exempt from the budget entirely: an evicted-then-readmitted rank
+    # is the mechanism working, not a failure to police (the launch
+    # `timeout` still bounds a flapping loop).
     attempts: Dict[int, int] = {i: 0 for i in range(nworkers)}
+    faults: Dict[int, int] = {i: 0 for i in range(nworkers)}
+    readmissions = 0
     finished: Dict[int, bool] = {i: False for i in range(nworkers)}
 
     def spawn(i: int) -> None:
@@ -118,6 +134,8 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
         # chaos: workers rendezvous through the tracker-front proxy
         env["RABIT_TRACKER_URI"] = tracker_addr[0]
         env["RABIT_TRACKER_PORT"] = str(tracker_addr[1])
+        if elastic:
+            env["RABIT_ELASTIC"] = "1"
         procs[i] = subprocess.Popen(cmd, env=env)
 
     try:
@@ -138,12 +156,22 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
                     finished[i] = True
                     continue
                 attempts[i] += 1
-                if attempts[i] > max_attempts:
-                    raise RuntimeError(
-                        f"worker {i} failed rc={rc} after "
-                        f"{max_attempts} attempts")
+                if elastic:
+                    # re-admit, not respawn-against-budget: the tracker
+                    # evicts the dead rank (poll evidence or the worker
+                    # side's evict call) so survivors re-form at N-1;
+                    # this relaunch rejoins toward the target world
+                    readmissions += 1
+                else:
+                    faults[i] += 1
+                    if faults[i] > max_attempts:
+                        raise RuntimeError(
+                            f"worker {i} failed rc={rc} after "
+                            f"{max_attempts} attempts (per-rank "
+                            "budget)")
                 if not quiet:
-                    print(f"[launch] worker {i} died rc={rc}; respawn "
+                    verb = "re-admit" if elastic else "respawn"
+                    print(f"[launch] worker {i} died rc={rc}; {verb} "
                           f"attempt {attempts[i]}", file=sys.stderr,
                           flush=True)
                 spawn(i)
@@ -161,6 +189,9 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
             # must stay bounded no matter how many recovery epochs ran
             stats["services_retained"] = tracker.service_count()
             stats["total_attempts"] = sum(attempts.values())
+            stats["attempts_by_rank"] = dict(attempts)
+            stats["readmissions"] = readmissions
+            stats["membership"] = tracker.membership_doc()
             # fleet-merged telemetry (per-rank summaries shipped via the
             # metrics command) — how cluster tests assert that recovery
             # spans/counters actually fired on the workers
@@ -191,6 +222,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("--chaos", default=None, metavar="SPEC",
                     help="fault-injection schedule: JSON, @file.json "
                          "(default: RABIT_CHAOS env)")
+    ap.add_argument("--elastic", action="store_true", default=None,
+                    help="elastic world membership: evict dead ranks "
+                         "so survivors continue at N-1, re-admit them "
+                         "on relaunch (default: RABIT_ELASTIC env)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if args.cmd and args.cmd[0] == "--":
@@ -198,7 +233,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not args.cmd:
         ap.error("missing worker command")
     return launch(args.num_workers, args.cmd, args.max_attempts,
-                  args.timeout, chaos=args.chaos)
+                  args.timeout, chaos=args.chaos, elastic=args.elastic)
 
 
 if __name__ == "__main__":

@@ -77,6 +77,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 from ..telemetry.aggregate import format_fleet_table, merge_summaries
+from . import membership as _membership
 
 MAGIC = 0x52425401
 NO_RANK = 0xFFFFFFFF
@@ -147,8 +148,24 @@ class Tracker:
                  coordinator: bool = False,
                  ready_timeout: Optional[float] = None,
                  link_rewrite=None,
-                 metrics_port: Optional[int] = None):
+                 metrics_port: Optional[int] = None,
+                 elastic: Optional[bool] = None):
         self.nworkers = nworkers
+        # elastic world membership (ISSUE 9): when on, the tracker is
+        # the membership authority for the live job — dead ranks are
+        # EVICTED (``evict`` command, or poll evidence of a silent
+        # endpoint) so survivors re-form at world N-1 instead of
+        # stalling for the exact replacement, and late joiners are
+        # parked (``join`` command) until the next epoch boundary
+        # re-admits them back toward the target world. Off by default:
+        # with ``rabit_elastic`` unset every registration batch waits
+        # for the full fixed world exactly as before.
+        if elastic is None:
+            elastic = _membership.elastic_enabled()
+        self.elastic = bool(elastic)
+        self._member = (_membership.MembershipView(nworkers)
+                        if self.elastic else None)
+        self._endpoint_misses: Dict[str, int] = {}
         self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
@@ -257,8 +274,11 @@ class Tracker:
         with self._lock:
             return len(self._services)
 
-    def _new_coordinator(self, epoch: int) -> Tuple[str, int]:
-        """Start this epoch's coordination service on a fresh port.
+    def _new_coordinator(self, epoch: int,
+                         world: Optional[int] = None) -> Tuple[str, int]:
+        """Start this epoch's coordination service on a fresh port,
+        sized to the epoch's world (an elastic epoch may be smaller
+        than the launch-time target).
 
         The free-port probe binds with the same family/wildcard the
         service will use (an IPv4-loopback probe says nothing about the
@@ -286,8 +306,9 @@ class Tracker:
             try:
                 # liveness detection off in the service: failure
                 # detection is the socket control plane's job
-                svc = compat.start_service(fmt.format(p=port),
-                                           self.nworkers)
+                svc = compat.start_service(
+                    fmt.format(p=port),
+                    self.nworkers if world is None else world)
             except Exception as e:  # noqa: BLE001 - retried on next family
                 last_err = e
                 continue
@@ -375,6 +396,26 @@ class Tracker:
             ("rabit_tracker_polls_total",
              "Completed endpoint poll sweeps.", "counter", [({}, polls)]),
         ]
+        if self.elastic:
+            with self._lock:
+                world_now = self._member.world()
+                evs, adms = (self._member.evictions,
+                             self._member.admissions)
+            gauges.append((
+                "rabit_world_size",
+                "Live world size of the current membership epoch "
+                "(elastic jobs shrink below the launch target and "
+                "grow back on re-admission).", "gauge",
+                [({}, world_now)]))
+            gauges.append((
+                "rabit_member_evictions_total",
+                "Ranks evicted from the live job (watchdog/poll "
+                "evidence or the evict command).", "counter",
+                [({}, evs)]))
+            gauges.append((
+                "rabit_member_admissions_total",
+                "Parked joiners admitted at an epoch boundary.",
+                "counter", [({}, adms)]))
         if topo.get("groups"):
             sizes = [len(g) for g in topo["groups"]]
             gauges.append((
@@ -432,6 +473,26 @@ class Tracker:
                 if doc is not None:
                     with self._lock:
                         self._metrics[tid] = doc
+                        self._endpoint_misses[tid] = 0
+                    continue
+                # poll evidence of a partition: an endpoint that HAS
+                # answered before and now stays silent for several
+                # sweeps is indistinguishable from a dead rank to the
+                # fleet — in an elastic world that is grounds for
+                # eviction (the watchdog catches the same failure from
+                # the inside; this catches it when the process is
+                # unreachable rather than crashed)
+                with self._lock:
+                    seen_before = tid in self._metrics
+                    misses = self._endpoint_misses.get(tid, 0) + 1
+                    self._endpoint_misses[tid] = misses
+                    rank = self._ranks.get(tid)
+                    live_rank = (self.elastic and rank is not None
+                                 and rank in self._member.live)
+                if (self.elastic and seen_before and live_rank
+                        and misses >= _membership.EVICT_POLL_MISSES):
+                    self.evict_rank(
+                        rank, f"endpoint silent for {misses} polls")
             with self._lock:
                 summaries = dict(self._metrics)
                 self._poll_count += 1
@@ -565,12 +626,41 @@ class Tracker:
                     doc = dict(self._skew)
                 _send_str(conn, json.dumps(doc))
                 conn.close()
+            elif cmd == "world":
+                _send_str(conn, json.dumps(self.membership_doc()))
+                conn.close()
+            elif cmd == "evict":
+                payload = _recv_str(conn)
+                try:
+                    doc = json.loads(payload)
+                except ValueError:
+                    doc = None
+                ok = False
+                if isinstance(doc, dict) and doc.get("rank") is not None:
+                    ok = self.evict_rank(int(doc["rank"]),
+                                         str(doc.get("reason", "")))
+                _send_u32(conn, 1 if ok else 0)
+                conn.close()
+            elif cmd == "join":
+                host = _recv_str(conn)
+                port = _recv_u32(conn)
+                flags = _recv_u32(conn)
+                token = _recv_str(conn)
+                self._register(conn, task_id, host, port, flags, token,
+                               join=True)
             elif cmd == "shutdown":
                 with self._lock:
                     rank = self._ranks.get(task_id)
                     if rank is not None:
                         self._shutdown_ranks.add(rank)
-                    all_down = len(self._shutdown_ranks) >= self.nworkers
+                    # an elastic job is done when the LIVE world is
+                    # down — evicted ranks never send shutdown
+                    if self.elastic and self._member.live:
+                        all_down = (self._member.live
+                                    <= self._shutdown_ranks)
+                    else:
+                        all_down = (len(self._shutdown_ranks)
+                                    >= self.nworkers)
                 _send_u32(conn, 1)
                 conn.close()
                 if all_down:
@@ -590,44 +680,158 @@ class Tracker:
             except OSError:
                 pass
 
+    def _expected_ranks(self) -> set:
+        """Ranks the current registration batch must contain before it
+        forms (caller holds the lock): the fixed world, or — elastic —
+        the live membership view's survivors plus parked joiners."""
+        if self.elastic:
+            return self._member.expected()
+        return set(range(self.nworkers))
+
+    def _try_complete_batch_locked(self):
+        """(batch, epoch) when every expected rank is pending, else
+        None. Caller holds the lock and, on success, must run
+        ``_assign`` OUTSIDE it. Factored out of ``_register`` because
+        an EVICTION can also complete a batch: survivors re-register
+        and block waiting for a dead rank until the poll loop (or an
+        ``evict`` command) removes it from the expected set."""
+        expected = self._expected_ranks()
+        if not expected or not expected <= set(self._pending):
+            return None
+        batch = {r: self._pending.pop(r) for r in expected}
+        self._epoch += 1
+        if self.elastic:
+            admitted = self._member.formed(batch)
+            for r in sorted(admitted):
+                self._note_transition("admit", r, "joined at epoch "
+                                      f"{self._epoch}")
+        self._cv.notify_all()
+        return batch, self._epoch
+
     def _register(self, conn, task_id: str, host: str, port: int,
-                  flags: int = 0, token: str = "") -> None:
+                  flags: int = 0, token: str = "",
+                  join: bool = False) -> None:
+        grace_s: Optional[float] = None
         with self._cv:
             if task_id not in self._ranks:
-                self._ranks[task_id] = len(self._ranks)
+                rank = len(self._ranks)
+                if self.elastic and rank >= self.nworkers \
+                        and self._member.evicted:
+                    # replacement hardware arrives under a NEW task_id:
+                    # adopt the lowest vacated stable rank so the world
+                    # can grow back to target (and the newcomer inherits
+                    # that rank's durable checkpoint shard directory)
+                    rank = min(self._member.evicted)
+                self._ranks[task_id] = rank
             rank = self._ranks[task_id]
             if rank >= self.nworkers:
                 conn.close()
                 return
+            if self.elastic:
+                m = self._member
+                if join or rank in m.evicted or \
+                        (m.live and rank not in m.live):
+                    # (re-)admission: parked until the epoch boundary —
+                    # a joiner must never perturb an in-flight world
+                    m.park(rank)
+                    grace_s = _membership.join_grace_ms() / 1e3 or None
             self._shutdown_ranks.discard(rank)
             self._pending[rank] = (conn, host, port, flags, token)
-            if len(self._pending) == self.nworkers:
-                batch = dict(self._pending)
-                self._pending.clear()
-                self._epoch += 1
-                epoch = self._epoch
-                self._cv.notify_all()
-                # assignment happens outside the lock in this thread
-            else:
+            got = self._try_complete_batch_locked()
+            if got is None:
                 self._cv.wait_for(
-                    lambda: rank not in self._pending or self._done.is_set())
+                    lambda: rank not in self._pending
+                    or self._done.is_set(), timeout=grace_s)
+                if rank in self._pending and \
+                        self._pending[rank][0] is conn:
+                    # parked joiner outlived rabit_join_grace_ms with
+                    # no epoch boundary: bounce it (the joiner retries)
+                    # rather than hold its socket open forever
+                    del self._pending[rank]
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
                 return  # the completing thread serves everyone
+            batch, epoch = got
         self._assign(batch, epoch)
+
+    # -- elastic membership (ISSUE 9) -------------------------------------
+    def membership_doc(self) -> dict:
+        """The ``world`` wire command's payload: the live membership
+        view, or a static fixed-world doc when elastic is off (so the
+        command always answers — a worker probing an inelastic tracker
+        learns membership is fixed rather than timing out)."""
+        with self._lock:
+            if self.elastic:
+                return self._member.doc(self._epoch)
+            return {"epoch": self._epoch, "world": self.nworkers,
+                    "target": self.nworkers,
+                    "live": list(range(self.nworkers)), "evicted": [],
+                    "joining": [], "generation": 0, "elastic": False}
+
+    def _note_transition(self, kind: str, rank: int, detail: str) -> None:
+        """Make a membership transition observable: a counter + a
+        zero-duration ``membership.transition`` span (trace_report
+        renders these on the timeline) + a flight-recorder note naming
+        the rank, so a post-mortem bundle shows WHY the world
+        resized."""
+        from .. import telemetry
+        from ..telemetry import flight
+        telemetry.count(f"membership.{kind}", provenance="membership")
+        telemetry.record_span("membership.transition", 0.0,
+                              op=kind, provenance="membership",
+                              rank=rank, detail=detail)
+        flight.note(f"member_{kind}", f"rank {rank}: {detail}")
+        print(f"[tracker] membership: {kind} rank {rank} ({detail})",
+              file=sys.stderr, flush=True)
+
+    def evict_rank(self, rank: int, reason: str = "") -> bool:
+        """Evict ``rank`` from the live job (the ``evict`` wire
+        command, or the poll loop's silent-endpoint evidence). The
+        rank leaves the expected set immediately, so survivors already
+        blocked in re-registration form their N-1 batch NOW instead of
+        waiting out the ready timeout on a dead peer. No-op unless
+        elastic."""
+        if not self.elastic or not 0 <= int(rank) < self.nworkers:
+            return False
+        rank = int(rank)
+        with self._cv:
+            if not self._member.evict(rank):
+                return False
+            pend = self._pending.pop(rank, None)
+            got = self._try_complete_batch_locked()
+        self._note_transition("evict", rank, reason or "evicted")
+        if pend is not None:
+            try:
+                pend[0].close()
+            except OSError:
+                pass
+        if got is not None:
+            self._assign(*got)
+        return True
 
     def _assign(self,
                 batch: Dict[int, Tuple[socket.socket, str, int, int,
                                        str]],
                 epoch: int) -> None:
-        world = self.nworkers
-        addr = {r: (h, p, tok) for r, (c, h, p, f, tok) in batch.items()}
-        conns = {r: c for r, (c, h, p, f, tok) in batch.items()}
+        # Elastic worlds may be holey in STABLE rank space (rank 1 of
+        # {0, 2, 3} is gone): schedules are built over dense collective
+        # SLOTS, and the wire `rank` field carries the slot. With a
+        # fixed world the batch is always the full contiguous range, so
+        # the mapping is the identity and nothing changes byte-wise.
+        world = len(batch) if self.elastic else self.nworkers
+        slot_of = _membership.dense_slots(batch)
+        addr = {slot_of[r]: (h, p, tok)
+                for r, (c, h, p, f, tok) in batch.items()}
+        conns = {slot_of[r]: c for r, (c, h, p, f, tok) in batch.items()}
         # host a coordinator when configured OR when any worker advertised
         # data-plane need in its registration flags (the Python engine API
         # path is invisible to the launcher's argv/env autodetect)
         want_coord = self._coordinator or any(
             f & FLAG_DATAPLANE for (c, h, p, f, tok) in batch.values())
         try:
-            coord_host, coord_port = (self._new_coordinator(epoch)
+            coord_host, coord_port = (self._new_coordinator(epoch, world)
                                       if want_coord else ("", 0))
         except Exception as e:  # noqa: BLE001 - reject batch loudly
             # a silent failure here would hang every worker in this
@@ -668,7 +872,7 @@ class Tracker:
         by_host: Dict[str, List[int]] = {}
         for rank in sorted(batch):
             c, h, p, f, tok = batch[rank]
-            by_host.setdefault(_src_ip(c) or h, []).append(rank)
+            by_host.setdefault(_src_ip(c) or h, []).append(slot_of[rank])
         groups = list(by_host.values())
         with self._lock:
             self._topo = {
@@ -677,7 +881,7 @@ class Tracker:
                 "delegates": [min(g) for g in groups],
                 "single_host": single_host,
             }
-        for rank in sorted(batch):
+        for rank in sorted(slot_of.values()):
             conn = conns[rank]
             parent, children = tree_neighbors(rank, world)
             tree_nbrs = ([] if parent is None else [parent]) + children

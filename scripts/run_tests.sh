@@ -22,7 +22,9 @@ if command -v ruff >/dev/null 2>&1; then
       rabit_tpu/engine/dataplane.py rabit_tpu/utils/watchdog.py \
       rabit_tpu/chaos/proxy.py rabit_tpu/telemetry/prom.py \
       rabit_tpu/telemetry/live.py rabit_tpu/telemetry/profile.py \
-      rabit_tpu/telemetry/skew.py rabit_tpu/tracker/tracker.py
+      rabit_tpu/telemetry/skew.py rabit_tpu/tracker/tracker.py \
+      rabit_tpu/tracker/membership.py rabit_tpu/parallel/topology.py \
+      rabit_tpu/parallel/dispatch.py
 else
   # containers without ruff fall back to the stdlib-only subset
   python tools/lint.py
@@ -53,6 +55,12 @@ echo "== tier 0g: skew-adaptation smoke (digest -> dispatch -> re-root) =="
 # provenance -> adapted (re-rooted tree) schedule on a 2-rank mesh,
 # with the reduction still numerically correct
 JAX_PLATFORMS=cpu python -m rabit_tpu.telemetry.skew --smoke
+
+echo "== tier 0h: elastic-membership smoke (evict -> shrink -> rejoin) =="
+# a live elastic tracker must evict a dead rank on wire evidence,
+# re-form the survivors at N-1, park a late joiner until the epoch
+# boundary, and re-admit it back to N — pure control plane, no jax
+python -m rabit_tpu.tracker.membership --smoke
 
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null

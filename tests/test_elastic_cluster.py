@@ -1,0 +1,79 @@
+"""Elastic membership, cluster level (slow tier): a real 4-process
+world under the local launcher loses one rank mid-job, the survivors
+re-form at world 3 without a cold restart, and the relaunched rank is
+re-admitted back to world 4 at the next epoch boundary — with every
+epoch's durable checkpoint bit-exact across ranks and the whole
+transition visible in the launcher's membership stats
+(doc/fault_tolerance.md "Elastic membership")."""
+
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKERS = os.path.join(ROOT, "tests", "workers")
+
+pytestmark = [pytest.mark.slow]
+
+sys.path.insert(0, ROOT)
+
+
+def test_kill_and_readmit_keeps_checkpoints_bit_exact(tmp_path):
+    from rabit_tpu.engine.ckpt_store import CheckpointStore
+    from rabit_tpu.tracker.launch import launch
+
+    out = str(tmp_path)
+    cmd = [sys.executable, os.path.join(WORKERS, "elastic_worker.py")]
+    env_old = {}
+    for k, v in {"RABIT_ELASTIC": "1", "ELASTIC_OUT": out,
+                 "KILL_TASK": "1", "ELASTIC_TARGET": "4"}.items():
+        env_old[k] = os.environ.get(k)
+        os.environ[k] = v
+    stats = {}
+    try:
+        rc = launch(4, cmd, max_attempts=3, timeout=120, stats=stats,
+                    elastic=True)
+    finally:
+        for k, v in env_old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    assert rc == 0
+
+    # the launcher saw the death as a re-admission, not a fault
+    assert stats["readmissions"] >= 1, stats
+    doc = stats["membership"]
+    assert doc["elastic"] and doc["world"] == 4, doc
+    assert doc["evicted"] == [] and doc["joining"] == [], doc
+
+    # survivors went 4 -> 3 -> 4 across epochs 1 -> 2 -> 3
+    for r in (0, 2, 3):
+        with open(os.path.join(out, f"r{r}.log")) as f:
+            lines = f.read().splitlines()
+        worlds = [(int(ln.split("world=")[1].split()[0]),
+                   int(ln.split("epoch=")[1].split()[0]))
+                  for ln in lines if "world=" in ln]
+        assert worlds == [(4, 1), (3, 2), (4, 3)], (r, lines)
+
+    # the victim died once, then re-joined the grown world at epoch 3
+    with open(os.path.join(out, "r1.log")) as f:
+        victim = f.read().splitlines()
+    assert any("dying" in ln for ln in victim), victim
+    assert any("rejoined" in ln and "world=4" in ln and "epoch=3" in ln
+               for ln in victim), victim
+    # shard redistribution: the relaunched (empty) store adopted the
+    # survivors' shrunk-world checkpoint before writing its own
+    assert any("adopted v1" in ln for ln in victim), victim
+
+    # bit-exactness: every rank's durable checkpoints are byte-identical
+    # to the pure function of (epoch, world) — including the joiner's
+    # adopted copy of the version written while it was out of the world
+    v1 = json.dumps({"epoch": 2, "world": 3}, sort_keys=True).encode()
+    v2 = json.dumps({"epoch": 3, "world": 4}, sort_keys=True).encode()
+    for r in range(4):
+        st = CheckpointStore(os.path.join(out, "ckpt"), rank=r, keep=2)
+        assert st.load(1) == (v1, b""), f"rank {r} v1 differs"
+        assert st.load(2) == (v2, b""), f"rank {r} v2 differs"

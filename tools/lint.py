@@ -40,6 +40,13 @@ this implements the highest-signal subset with only the stdlib:
   blackout windows degrade into logged backoff instead of one-shot
   failures. Servers/acceptors and the fault injector itself are
   allowlisted (R001_ALLOWED); ``# noqa: R001`` exempts a line.
+- **epoch-reset hook presence** (R002, repo-specific): modules that
+  hold world-size-derived state (the R002_MODULES list) must define an
+  ``epoch_reset(world)`` function or method — elastic membership
+  (``tracker/membership.py``) resizes the live world, and any module
+  that caches schedules, groupings, digests, or counters keyed on the
+  old size silently corrupts the new world unless it exposes the hook
+  the engines drive on every registration-epoch transition.
 
 ``scripts/run_tests.sh`` prefers ``ruff check`` when installed; this is
 the fallback so the tier never silently no-ops. Exit 0 clean, 1 with
@@ -102,6 +109,22 @@ R001_ALLOWED = {
 }
 
 _R001_CALLS = {"socket", "create_connection"}
+
+# R002: modules holding world-size-derived state. Each must expose an
+# ``epoch_reset(world)`` hook (module-level function or a method on any
+# class) that the engines call on every elastic registration-epoch
+# transition. Grown together with elastic membership: add a module here
+# the moment it caches anything keyed on the world size.
+R002_MODULES = (
+    os.path.join("rabit_tpu", "tracker", "membership.py"),
+    os.path.join("rabit_tpu", "telemetry", "skew.py"),
+    os.path.join("rabit_tpu", "parallel", "topology.py"),
+    os.path.join("rabit_tpu", "parallel", "dispatch.py"),
+    os.path.join("rabit_tpu", "engine", "xla.py"),
+    os.path.join("rabit_tpu", "engine", "native.py"),
+)
+
+_R002_HOOK = "epoch_reset"
 
 # T003: files that mint /metrics family names. Every name found here
 # (via _t003_minted_names) must be registered in prom.py's
@@ -215,6 +238,22 @@ def _r001_issues(rel, tree, src):
     return issues
 
 
+def _r002_issues(rel, tree):
+    """World-size-derived state modules must expose the epoch-reset
+    hook (an ``epoch_reset`` def anywhere in the module — top level or
+    a method)."""
+    if rel not in R002_MODULES:
+        return []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == _R002_HOOK:
+            return []
+    return [(rel, 1, "R002",
+             f"module holds world-size-derived state but defines no "
+             f"'{_R002_HOOK}(world)' hook (see R002_MODULES; elastic "
+             "resizes call it on every registration-epoch transition)")]
+
+
 def _calls_any(fn_node, call_names) -> bool:
     for node in ast.walk(fn_node):
         if not isinstance(node, ast.Call):
@@ -325,6 +364,7 @@ def check_file(path: str):
                 issues.append((rel, node.lineno, "F401",
                                f"'{shown}' imported but unused"))
     issues.extend(_r001_issues(rel, tree, src))
+    issues.extend(_r002_issues(rel, tree))
     issues.extend(_t003_issues(rel, tree))
     required = SPAN_REQUIRED.get(rel)
     if required:
