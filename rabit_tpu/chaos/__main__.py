@@ -4,6 +4,11 @@ echo server behind a chaos proxy, inject exactly one mid-transfer
 connection reset, recover through the retry helper, and verify the
 replayed payload byte-for-byte. Exercises proxy + schedule + retry
 together in under a second, with no tracker, jax, or native build.
+
+A second round (ISSUE 10) exercises ``tracker_kill``: a targeted rule
+fires the proxy's kill hook exactly once — the supervisor-side
+murder/respawn path — then the retried connection echoes clean through
+the "respawned" upstream.
 """
 
 from __future__ import annotations
@@ -77,9 +82,24 @@ def smoke() -> int:
         resets = [e for e in proxy.events if e[1] == "reset"]
         assert len(resets) == 1, f"expected 1 injected reset: {proxy.events}"
         assert proxy.accepted >= 2, "retry never reconnected"
+
+        # round 2: tracker_kill fires the kill hook on the targeted
+        # connection (once — max_times defaults to 1), the triggering
+        # client sees an RST, and the retry lands on the still-running
+        # upstream exactly as it would on a --resume'd tracker
+        kills = []
+        proxy.kill_hook = lambda delay_ms: kills.append(delay_ms)
+        proxy.schedule.rules.append(
+            Rule("tracker_kill", conn=proxy.accepted, delay_ms=250))
+        retry.retry_call(round_trip, attempts=4, base_s=0.05,
+                         desc="chaos tracker-kill round-trip")
+        fired = [e for e in proxy.events if e[1] == "tracker_kill"]
+        assert len(fired) == 1, \
+            f"expected 1 tracker_kill event: {proxy.events}"
+        assert kills == [250.0], f"kill hook saw {kills}"
     srv.close()
-    print("chaos smoke ok (1 reset injected, retry recovered, "
-          "payload intact)")
+    print("chaos smoke ok (1 reset + 1 tracker_kill injected, retry "
+          "recovered, payload intact)")
     return 0
 
 

@@ -111,10 +111,15 @@ class ChaosProxy:
     def __init__(self, upstream_host: str, upstream_port: int,
                  schedule: Optional[Schedule] = None,
                  listen_host: str = "127.0.0.1", port: int = 0,
-                 name: str = "chaos"):
+                 name: str = "chaos", kill_hook=None):
         self.upstream = (upstream_host, int(upstream_port))
         self.schedule = schedule or Schedule()
         self.name = name
+        # ``tracker_kill`` support: ``kill_hook(delay_ms)`` kills the
+        # proxied upstream (and, when the supervisor has a WAL,
+        # schedules a --resume respawn after delay_ms). None = the
+        # rule is inert on this proxy (e.g. link proxies).
+        self.kill_hook = kill_hook
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((listen_host, port))
@@ -202,6 +207,26 @@ class ChaosProxy:
             if blackout is not None and Schedule.consume(blackout):
                 self.refused += 1
                 self._event("blackout", index)
+                _hard_close(client)
+                continue
+            # tracker_kill (ISSUE 10): the first accept inside the
+            # rule's window (or its targeted conn index) murders the
+            # proxied tracker via the supervisor's kill hook — the
+            # triggering client sees an RST exactly as it would
+            # connecting to a freshly dead tracker
+            kill = next((r for r in rules if r.kind == "tracker_kill"
+                         and (self._in_window(r) or (r.window_s is None
+                                                     and r.conn == index))),
+                        None)
+            if kill is not None and self.kill_hook is not None \
+                    and Schedule.consume(kill):
+                self._event("tracker_kill", index)
+                try:
+                    self.kill_hook(kill.delay_ms)
+                except Exception as e:  # noqa: BLE001 - chaos never aborts
+                    print(f"[{self.name}] kill hook failed: {e}",
+                          file=sys.stderr, flush=True)
+                self.refused += 1
                 _hard_close(client)
                 continue
             try:

@@ -11,7 +11,7 @@ Rule fields (all optional except ``kind``):
 
 ========== ===========================================================
 ``kind``   ``delay`` | ``reset`` | ``partial`` | ``partition`` |
-           ``blackout``
+           ``blackout`` | ``tracker_kill``
 ``conn``   apply only to the nth accepted connection (0-based);
            ``None`` = every connection
 ``prob``   apply with this probability (seeded draw); default 1.0
@@ -26,7 +26,12 @@ Rule fields (all optional except ``kind``):
            ``partition`` stalls forwarding inside the window (packets
            neither delivered nor refused — the hung-peer shape),
            ``blackout`` refuses new connections inside it (the
-           tracker-restart shape)
+           tracker-restart shape), ``tracker_kill`` fires its kill
+           hook on the first accept inside it (the tracker-CRASH
+           shape: the proxy's upstream tracker is killed and — when a
+           WAL is configured — respawned with ``--resume`` after
+           ``delay_ms``; requires ``window_s`` or ``conn``, defaults
+           ``max_times`` to 1)
 ``target``  ``"tracker"`` | ``"link"`` | ``None`` (both, the
            default): which proxy class runs the rule. Link wiring has
            no retry around an accepted-then-reset handshake (a peer
@@ -45,7 +50,8 @@ import json
 import random
 from typing import List, Optional, Sequence, Tuple
 
-KINDS = ("delay", "reset", "partial", "partition", "blackout")
+KINDS = ("delay", "reset", "partial", "partition", "blackout",
+         "tracker_kill")
 TARGETS = ("tracker", "link")
 
 
@@ -64,6 +70,17 @@ class Rule:
                              f"got {kind!r}")
         if kind in ("partition", "blackout") and window_s is None:
             raise ValueError(f"chaos {kind!r} rule requires window_s")
+        if kind == "tracker_kill":
+            # the kill must be anchored (a window or a specific
+            # connection) or the very FIRST accept — registration —
+            # would murder the tracker before any world exists; and it
+            # defaults to firing once (a respawn loop is a different
+            # experiment than a crash)
+            if window_s is None and conn is None:
+                raise ValueError(
+                    "chaos 'tracker_kill' rule requires window_s or conn")
+            if max_times is None:
+                max_times = 1
         if target is not None and target not in TARGETS:
             raise ValueError(f"chaos rule target must be one of {TARGETS} "
                              f"or None, got {target!r}")

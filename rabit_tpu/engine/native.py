@@ -230,6 +230,13 @@ class NativeEngine(Engine):
         telemetry.configure(cfg)
         _profile.configure(cfg)
         self._start_live_plane(cfg)
+        if self.is_distributed:
+            # formed identity for the `resume` handshake (ISSUE 10):
+            # reconnecting pollers re-present it to a resumed tracker
+            from ..tracker import membership as _mship
+            _mship.note_identity(
+                os.environ.get("RABIT_TASK_ID", str(self.rank)),
+                self.rank, 0)
         ckpt_dir = cfg.get("rabit_ckpt_dir")
         if ckpt_dir:
             self._store = ckpt_store.CheckpointStore(
@@ -418,7 +425,15 @@ class NativeEngine(Engine):
                 log.log_warn("telemetry flush failed: %s", e)
         self._restore_env()
         self._watchdog.close()
-        self._check(self._lib.RbtFinalize(), "finalize")
+        # the shutdown handshake is a fresh tracker connection per
+        # attempt and idempotent tracker-side (a rank's `down` record
+        # is a set insert), so retry through a tracker crash -> WAL
+        # resume window rather than dying at the finish line
+        from ..utils import retry
+        retry.retry_call(
+            lambda: self._check(self._lib.RbtFinalize(), "finalize"),
+            attempts=6, base_s=0.4, max_s=4.0,
+            retry_on=(RuntimeError,), desc="finalize")
 
     def allreduce(self, buf: np.ndarray, op: int,
                   prepare_fun: Optional[Callable[[], None]] = None,
@@ -601,7 +616,18 @@ class NativeEngine(Engine):
             self._store.save(abs_v, payload)
 
     def tracker_print(self, msg: str) -> None:
-        self._check(self._lib.RbtTrackerPrint(msg.encode()), "tracker_print")
+        # one-shot control-plane command: each native call opens a
+        # fresh tracker connection, so ride out a brief tracker outage
+        # (crash -> WAL resume) the way the pollers do instead of
+        # letting one reset kill a worker whose results are long done.
+        # Duplicate delivery is harmless: worst case a line prints
+        # twice.
+        from ..utils import retry
+        retry.retry_call(
+            lambda: self._check(self._lib.RbtTrackerPrint(msg.encode()),
+                                "tracker_print"),
+            attempts=6, base_s=0.4, max_s=4.0,
+            retry_on=(RuntimeError,), desc="tracker_print")
 
     def init_after_exception(self) -> None:
         try:

@@ -132,6 +132,14 @@ class XlaEngine(Engine):
         _profile.configure(cfg)
         self._watchdog = Watchdog.from_config(cfg)
         self._start_live_plane(cfg)
+        if self._world > 1:
+            # formed identity for the `resume` handshake (ISSUE 10):
+            # reconnecting pollers re-present it to a resumed tracker
+            import os as _os
+            from ..tracker import membership as _mship
+            _mship.note_identity(
+                _os.environ.get("RABIT_TASK_ID", str(self._rank)),
+                self._rank, 0)
         ckpt_dir = cfg.get("rabit_ckpt_dir")
         if ckpt_dir:
             self._store = ckpt_store.CheckpointStore(

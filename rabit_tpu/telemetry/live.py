@@ -153,11 +153,21 @@ def start_rank_server(port: int, rank: int, world: int,
     ).start()
 
 
+# The last successful announce's (host, port, rank) — what
+# ``reannounce`` replays toward a RESUMED tracker (ISSUE 10). A
+# tracker restart replays endpoints from its WAL, but a torn tail can
+# lose the newest announce; the worker re-presenting its own endpoint
+# makes convergence unconditional.
+_last_announce: Optional[tuple] = None
+
+
 def announce_endpoint(host: str, port: int, rank: int,
                       timeout: float = 5.0) -> bool:
     """Tell the tracker where this rank's metrics endpoint lives (the
     ``endpoint`` wire command). Best-effort, like the shutdown-time
     metrics shipment: a run without a tracker returns False."""
+    global _last_announce
+    _last_announce = (host, int(port), int(rank))
     tr_host = (os.environ.get("RABIT_TRACKER_URI")
                or os.environ.get("DMLC_TRACKER_URI") or "")
     tr_port = (os.environ.get("RABIT_TRACKER_PORT")
@@ -182,6 +192,16 @@ def announce_endpoint(host: str, port: int, rank: int,
             return _recv_u32(conn) == 1
     except (OSError, ValueError, ConnectionError, retry.RetryError):
         return False
+
+
+def reannounce(timeout: float = 5.0) -> bool:
+    """Replay the last successful endpoint announce (reconnecting
+    pollers call this on a dead->alive tracker transition). False when
+    this process never announced."""
+    if _last_announce is None:
+        return False
+    host, port, rank = _last_announce
+    return announce_endpoint(host, port, rank, timeout=timeout)
 
 
 def poll_interval_s(cfg_or_none=None) -> float:

@@ -77,6 +77,9 @@ METRIC_FAMILIES = (
     "rabit_world_size",
     "rabit_member_evictions_total",
     "rabit_member_admissions_total",
+    # crash-recoverable tracker (tracker/tracker.py, ISSUE 10)
+    "rabit_tracker_restarts_total",
+    "rabit_wal_records_total",
 )
 
 

@@ -225,6 +225,24 @@ class FleetElection:
         self._epoch = 0
         self._laggard: Optional[int] = None
 
+    @classmethod
+    def seeded(cls, digest: Optional[dict]) -> "FleetElection":
+        """Rebuild an election from its last served digest (tracker
+        WAL replay, ISSUE 10): a resumed tracker must keep serving the
+        SAME verdict and epoch the fleet already adopted — a cold
+        election would restart the epoch at 1 and re-elect from empty
+        state, flapping every worker's jit cache key across a restart
+        that changed nothing about the fleet."""
+        el = cls()
+        d = parse_digest(digest)
+        if d is None:
+            return el
+        el._est.update(d["offsets_ms"])
+        el._est._laggard = d["laggard"]
+        el._laggard = d["laggard"]
+        el._epoch = max(1, d["epoch"])
+        return el
+
     def fold(self, raw: Optional[dict]) -> Optional[dict]:
         """Fold one sweep's raw digest; returns the digest to serve
         (None if there is nothing to fold and never has been)."""
@@ -311,15 +329,14 @@ def parse_digest(doc) -> Optional[dict]:
     return {"epoch": epoch, "offsets_ms": offsets, "laggard": laggard}
 
 
-def fetch_skew(host: str, port: int, task_id: str = "0",
-               timeout: float = FETCH_TIMEOUT_S) -> Optional[dict]:
-    """Pull the tracker's current skew digest (``skew`` wire command,
-    same rendezvous protocol as ``topo``). Best-effort: returns None
-    instead of raising — a tracker that predates the command, went
-    away, or has no digest yet just means no adaptation. The default
-    timeout is deliberately tight: the only production caller is the
-    :class:`SkewMonitor` poller thread, and a wedged tracker must not
-    wedge the poller for whole seconds per attempt."""
+def _fetch_skew_raw(host: str, port: int, task_id: str = "0",
+                    timeout: float = FETCH_TIMEOUT_S):
+    """``(reached, digest)``: ``reached`` is True when the wire round
+    trip completed — even when the tracker served ``"{}"`` (no digest
+    yet) or something unparseable. The split matters to the poller's
+    circuit breaker: "the tracker is alive but has no verdict" must
+    re-arm the breaker, while "the tracker is unreachable" must trip
+    it."""
     from ..tracker.tracker import MAGIC, _recv_str, _send_str, _send_u32
     from ..utils import retry
     try:
@@ -330,9 +347,28 @@ def fetch_skew(host: str, port: int, task_id: str = "0",
             _send_str(conn, "skew")
             _send_str(conn, task_id)
             _send_u32(conn, 0)  # num_attempt (informational)
-            doc = json.loads(_recv_str(conn))
-        return parse_digest(doc)
-    except (OSError, ValueError, ConnectionError, retry.RetryError):
+            raw = _recv_str(conn)
+    except (OSError, ConnectionError, retry.RetryError):
+        return False, None
+    try:
+        doc = json.loads(raw)
+    except ValueError:
+        return True, None
+    return True, parse_digest(doc)
+
+
+def fetch_skew(host: str, port: int, task_id: str = "0",
+               timeout: float = FETCH_TIMEOUT_S) -> Optional[dict]:
+    """Pull the tracker's current skew digest (``skew`` wire command,
+    same rendezvous protocol as ``topo``). Best-effort: returns None
+    instead of raising — a tracker that predates the command, went
+    away, or has no digest yet just means no adaptation. The default
+    timeout is deliberately tight: the only production caller is the
+    :class:`SkewMonitor` poller thread, and a wedged tracker must not
+    wedge the poller for whole seconds per attempt."""
+    try:
+        return _fetch_skew_raw(host, port, task_id, timeout)[1]
+    except ValueError:
         return None
 
 
@@ -363,6 +399,9 @@ class SkewMonitor:
         self._synced = False
         self._poller: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # consecutive failed round trips (the circuit breaker's state;
+        # held on the instance so tests and `breaker_state` can see it)
+        self._misses = 0
 
     def observe(self, doc) -> Optional[dict]:
         """Cache one digest verbatim; returns the current candidate."""
@@ -426,11 +465,37 @@ class SkewMonitor:
                 target=self._poll_loop, name="rabit-skew-poll", daemon=True)
             self._poller.start()
 
+    def breaker_state(self) -> dict:
+        """Circuit-breaker introspection (tests, diagnostics)."""
+        with self._lock:
+            misses = self._misses
+        return {"misses": misses,
+                "tripped": misses >= BREAKER_FAILURES}
+
+    def _on_reconnect(self) -> None:
+        """Dead->alive transition: the tracker we just reached may be
+        a RESUMED incarnation that replayed its WAL (ISSUE 10) — re-
+        present this worker's identity over the ``resume`` handshake
+        and re-announce its metrics endpoint so the new incarnation's
+        world view converges without any re-registration. Best-effort:
+        the poller must keep polling whatever happens here."""
+        from ..tracker import membership
+        from . import live
+        try:
+            membership.present_resume()
+        except Exception:  # noqa: BLE001 - reconnect is best-effort
+            pass
+        try:
+            live.reannounce()
+        except Exception:  # noqa: BLE001 - reconnect is best-effort
+            pass
+
     def _poll_loop(self) -> None:
-        misses = 0
         while True:
             interval = poll_interval_s()
-            if misses >= BREAKER_FAILURES:
+            with self._lock:
+                tripped = self._misses >= BREAKER_FAILURES
+            if tripped:
                 interval *= BREAKER_BACKOFF
             if self._stop.wait(interval):
                 return
@@ -439,14 +504,27 @@ class SkewMonitor:
                 continue
             host, _, port = addr.rpartition(":")
             try:
-                d = fetch_skew(host, int(port))
+                reached, d = _fetch_skew_raw(host, int(port))
             except ValueError:
-                d = None
-            if d is not None:
-                misses = 0
-                self.observe(d)
+                reached, d = False, None
+            if reached:
+                # satellite fix (ISSUE 10): the breaker re-arms on the
+                # first successful ROUND TRIP, not the first parsed
+                # digest. A freshly resumed tracker serves "{}" until
+                # its first poll sweep, and the old digest-based reset
+                # counted that as a miss — so a poller that outlived a
+                # tracker restart stayed at the 10x backoff cadence
+                # forever even though the tracker was back.
+                with self._lock:
+                    was_tripped = self._misses >= BREAKER_FAILURES
+                    self._misses = 0
+                if was_tripped:
+                    self._on_reconnect()
+                if d is not None:
+                    self.observe(d)
             else:
-                misses += 1
+                with self._lock:
+                    self._misses += 1
 
 
 _monitor = SkewMonitor()

@@ -39,12 +39,12 @@ class _ChaosFarm:
         self._by_target: Dict[Tuple[str, int], object] = {}
         self.tracker_proxy = None
 
-    def front_tracker(self, tracker: Tracker):
+    def front_tracker(self, tracker: Tracker, kill_hook=None):
         from ..chaos.proxy import ChaosProxy
         self.tracker_proxy = ChaosProxy(
             tracker.host, tracker.port,
             self.schedule.for_target("tracker").reseed(0),
-            name="chaos-tracker").start()
+            name="chaos-tracker", kill_hook=kill_hook).start()
         return self.tracker_proxy
 
     def link_rewrite(self, peer_rank: int, host: str,
@@ -72,6 +72,73 @@ class _ChaosFarm:
             events += len(p.events)
             p.stop()
         return {"proxies": len(proxies), "events": events}
+
+
+class _TrackerSupervisor:
+    """Supervise the in-process tracker the way the launcher already
+    supervises workers (ISSUE 10): a crash — injected by the chaos
+    ``tracker_kill`` rule or scripted by a test — is followed by a
+    ``resume=True`` respawn on the SAME pinned host:port once the
+    scheduled outage elapses, so the env every worker was launched
+    with stays valid and the replayed WAL re-adopts the live world.
+    Without a WAL dir a killed tracker stays dead (exactly today's
+    failure mode — supervision never invents durability)."""
+
+    def __init__(self, tracker: Tracker, wal_dir: Optional[str],
+                 factory, quiet: bool = False):
+        self.tracker = tracker
+        self.wal_dir = wal_dir
+        self._factory = factory  # (host, port) -> resumed Tracker
+        self.quiet = quiet
+        self.restarts = 0
+        self._lock = threading.Lock()
+        self._respawn_at: Optional[float] = None
+
+    def kill(self, delay_ms: float = 0.0) -> None:
+        """Chaos kill hook: crash the live tracker NOW; schedule the
+        ``--resume`` respawn ``delay_ms`` later (the outage the fleet
+        must ride out) when a WAL makes resume possible."""
+        with self._lock:
+            if self.tracker.crashed:
+                return
+            self.tracker.crash()
+            if not self.quiet:
+                print(f"[launch] tracker killed (outage "
+                      f"{delay_ms / 1e3:.1f}s"
+                      + (", will resume from WAL)" if self.wal_dir
+                         else ", no WAL: stays dead)"),
+                      file=sys.stderr, flush=True)
+            if self.wal_dir is not None:
+                self._respawn_at = time.monotonic() + delay_ms / 1e3
+
+    def poll(self) -> None:
+        """Called from the launcher's supervision loop, like the
+        per-worker ``Popen.poll``s."""
+        with self._lock:
+            if self._respawn_at is None or \
+                    time.monotonic() < self._respawn_at:
+                return
+            self._respawn_at = None
+            host, port = self.tracker.host, self.tracker.port
+        # the dead incarnation's listen socket can linger a beat past
+        # crash(); the pinned port must win before workers notice
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                fresh = self._factory(host, port)
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.05)
+        fresh.start()
+        with self._lock:
+            self.tracker = fresh
+            self.restarts += 1
+        if not self.quiet:
+            print(f"[launch] tracker resumed on {host}:{port} "
+                  f"(restart {self.restarts})", file=sys.stderr,
+                  flush=True)
 
 
 def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
@@ -106,13 +173,23 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
         elastic = (_membership.elastic_enabled()
                    or any(a == "rabit_elastic=1" for a in cmd))
     farm = _ChaosFarm(chaos) if chaos is not None else None
+    wal_dir = os.environ.get("RABIT_TRACKER_WAL_DIR") or None
     tracker = Tracker(
         nworkers, coordinator=coordinator,
         link_rewrite=farm.link_rewrite if farm else None,
-        elastic=elastic).start()
+        elastic=elastic, wal_dir=wal_dir).start()
+
+    def _resumed_tracker(host: str, port: int) -> Tracker:
+        return Tracker(
+            nworkers, host=host, port=port, coordinator=coordinator,
+            link_rewrite=farm.link_rewrite if farm else None,
+            elastic=elastic, wal_dir=wal_dir, resume=True)
+
+    sup = _TrackerSupervisor(tracker, wal_dir, _resumed_tracker,
+                             quiet=quiet)
     tracker_addr = (tracker.host, tracker.port)
     if farm is not None:
-        proxy = farm.front_tracker(tracker)
+        proxy = farm.front_tracker(tracker, kill_hook=sup.kill)
         tracker_addr = (proxy.host, proxy.port)
     procs: Dict[int, subprocess.Popen] = {}
     # respawn accounting is PER RANK: `attempts[i]` counts every spawn
@@ -143,6 +220,10 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
             spawn(i)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
+            # the tracker is supervised like the workers below: a
+            # chaos-killed tracker respawns with resume=True once its
+            # scheduled outage elapses
+            sup.poll()
             alive = False
             for i in range(nworkers):
                 p = procs.get(i)
@@ -184,6 +265,9 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
         raise RuntimeError(
             f"timeout/stall: finished={sum(finished.values())}/{nworkers}")
     finally:
+        # a respawn may have replaced the tracker object mid-run: all
+        # end-of-run reads and the teardown go to the LIVE incarnation
+        tracker = sup.tracker
         if stats is not None:
             # observability for tests: retained coordination services
             # must stay bounded no matter how many recovery epochs ran
@@ -200,6 +284,12 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
             # sweeps completed, and the last straggler snapshot —
             # captured BEFORE tracker.stop() tears the poller down
             stats["live"] = tracker.live_stats()
+            # crash-recovery accounting (ISSUE 10): tracker respawns
+            # counted like worker respawns, plus the journal's size
+            stats["tracker_restarts"] = sup.restarts
+            stats["tracker_wal"] = {"dir": wal_dir,
+                                    "records": tracker.wal_records(),
+                                    "restarts": tracker.restarts}
         for p in procs.values():
             if p.poll() is None:
                 p.kill()

@@ -198,6 +198,64 @@ def fetch_world(host: str, port: int, task_id: str = "0",
         return None
 
 
+# This worker's last formed (task_id, stable_rank, epoch) — the
+# identity it re-presents to a RESUMED tracker over the ``resume``
+# wire handshake (ISSUE 10). Engines stamp it after every successful
+# registration; None until the first world forms.
+_identity_lock = threading.Lock()
+_identity: Optional[tuple] = None
+
+
+def note_identity(task_id: str, rank: int, epoch: int) -> None:
+    """Record this worker's formed identity (engine post-registration
+    hook) so reconnecting pollers can re-present it to a resumed
+    tracker without a full re-registration."""
+    global _identity
+    with _identity_lock:
+        _identity = (str(task_id), int(rank), int(epoch))
+
+
+def identity() -> Optional[tuple]:
+    with _identity_lock:
+        return _identity
+
+
+def present_resume(host: Optional[str] = None,
+                   port: Optional[int] = None,
+                   timeout: float = 2.0) -> bool:
+    """Re-present this worker's ``(task_id, stable_rank, epoch)`` to a
+    (possibly resumed) tracker over the ``resume`` wire command. True
+    when the tracker reconciled the identity against its replayed WAL.
+    Best-effort and cheap: called from reconnecting pollers on a
+    dead->alive transition, never the dispatch path."""
+    ident = identity()
+    if ident is None:
+        return False
+    task_id, rank, epoch = ident
+    if host is None:
+        host = os.environ.get("RABIT_TRACKER_URI", "")
+    if port is None:
+        port = int(os.environ.get("RABIT_TRACKER_PORT", 0) or 0)
+    if not host or not port:
+        return False
+    from ..utils import retry
+    from .tracker import MAGIC, _recv_all, _send_str, _send_u32
+    import struct
+    try:
+        with retry.connect_with_retry(
+                host, int(port), timeout=timeout,
+                deadline=retry.Deadline(timeout)) as conn:
+            _send_u32(conn, MAGIC)
+            _send_str(conn, "resume")
+            _send_str(conn, task_id)
+            _send_u32(conn, 0)  # num_attempt (informational)
+            _send_str(conn, json.dumps({"rank": rank, "epoch": epoch}))
+            ok = struct.unpack("<I", _recv_all(conn, 4))[0]
+        return ok == 1
+    except (OSError, ValueError, ConnectionError, retry.RetryError):
+        return False
+
+
 class MembershipMonitor:
     """Worker-side cache of the tracker's membership view.
 
@@ -222,6 +280,10 @@ class MembershipMonitor:
         self._formed_generation = 0
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # consecutive failed refreshes: past RECONNECT_MISSES the
+        # tracker is considered dead, and the next success is a
+        # dead->alive transition worth a `resume` re-present
+        self._misses = 0
 
     def current(self) -> Optional[dict]:
         with self._lock:
@@ -236,12 +298,25 @@ class MembershipMonitor:
             self._formed_generation = (doc or {}).get(
                 "generation", self._formed_generation)
 
+    RECONNECT_MISSES = 3
+
     def refresh(self) -> Optional[dict]:
-        doc = (fetch_world(self.host, self.port, self.task_id)
-               if self.host and self.port else None)
-        if doc is not None:
+        if not (self.host and self.port):
+            return None
+        doc = fetch_world(self.host, self.port, self.task_id)
+        if doc is None:
             with self._lock:
-                self._doc = doc
+                self._misses += 1
+            return None
+        with self._lock:
+            was_dead = self._misses >= self.RECONNECT_MISSES
+            self._misses = 0
+            self._doc = doc
+        if was_dead:
+            # the tracker came back — possibly a resumed incarnation
+            # that replayed its WAL (ISSUE 10): re-present our formed
+            # identity so it reconciles us without re-registration
+            present_resume(self.host, self.port)
         return doc
 
     def reformation_due(self) -> bool:

@@ -74,10 +74,12 @@ import socket
 import struct
 import sys
 import threading
+import time
 from typing import Dict, List, Optional, Tuple
 
 from ..telemetry.aggregate import format_fleet_table, merge_summaries
 from . import membership as _membership
+from . import wal as _wal_mod
 
 MAGIC = 0x52425401
 NO_RANK = 0xFFFFFFFF
@@ -143,13 +145,34 @@ def _default_ready_timeout() -> float:
         return 60.0
 
 
+RESUME_GRACE_MS_DEFAULT = 15_000
+
+
+def resume_grace_ms() -> int:
+    """``rabit_tracker_resume_grace_ms`` (doc/parameters.md): how long
+    a resumed tracker waives poll-miss eviction evidence while worker
+    pollers reconnect — a brief tracker outage must never evict
+    healthy ranks."""
+    v = os.environ.get("RABIT_TRACKER_RESUME_GRACE_MS")
+    if not v:
+        return RESUME_GRACE_MS_DEFAULT
+    try:
+        return max(0, int(v))
+    except ValueError:
+        raise ValueError(
+            f"RABIT_TRACKER_RESUME_GRACE_MS must be an integer (ms), "
+            f"got {v!r}")
+
+
 class Tracker:
     def __init__(self, nworkers: int, host: str = "127.0.0.1", port: int = 0,
                  coordinator: bool = False,
                  ready_timeout: Optional[float] = None,
                  link_rewrite=None,
                  metrics_port: Optional[int] = None,
-                 elastic: Optional[bool] = None):
+                 elastic: Optional[bool] = None,
+                 wal_dir: Optional[str] = None,
+                 resume: bool = False):
         self.nworkers = nworkers
         # elastic world membership (ISSUE 9): when on, the tracker is
         # the membership authority for the live job — dead ranks are
@@ -239,6 +262,100 @@ class Tracker:
         # adapted schedules key on)
         self._skew: dict = {}
         self._skew_election = None  # lazy: telemetry.skew.FleetElection
+        # crash-recoverable control plane (ISSUE 10): when a WAL dir is
+        # configured (``rabit_tracker_wal_dir``), every control-plane
+        # transition below is journaled through tracker/wal.py BEFORE
+        # it takes effect, and ``resume=True`` replays the journal to
+        # re-adopt a live world after a tracker crash — same ranks,
+        # same epoch, no worker restart. With the knob unset every
+        # ``_wal`` call below is a no-op and behavior is byte-identical
+        # to a WAL-less tracker.
+        if wal_dir is None:
+            wal_dir = os.environ.get(_wal_mod.WAL_DIR_ENV) or None
+        self.wal_dir = wal_dir
+        self._wal_log: Optional[_wal_mod.WriteAheadLog] = None
+        self.restarts = 0
+        self.crashed = False
+        self._grace_until = 0.0
+        self._resumed_ranks: set = set()
+        if wal_dir is not None:
+            self._wal_log = _wal_mod.WriteAheadLog(wal_dir)
+            records = self._wal_log.open(resume=resume)
+            if resume:
+                self._replay(records)
+                self.restarts += 1
+                self._wal_log.record("resume", restarts=self.restarts,
+                                     epoch=self._epoch)
+                self._grace_until = (time.monotonic()
+                                     + resume_grace_ms() / 1e3)
+                self._note_resume(len(records))
+
+    def _replay(self, records) -> None:
+        """Restore journaled control-plane state (constructor only,
+        before the serve thread exists — no locking needed). Raw
+        mutations are deliberate: replay IS the WAL API's read side
+        (lint R003 exempts ``_replay``)."""
+        from ..telemetry import skew as _skew_mod
+        for kind, data in records:
+            if kind == "assign":
+                self._ranks[str(data["task"])] = int(data["rank"])
+            elif kind == "epoch":
+                self._epoch = int(data["epoch"])
+                if self.elastic and self._member is not None:
+                    self._member.formed(data.get("members", []))
+            elif kind == "park":
+                if self.elastic and self._member is not None:
+                    self._member.park(int(data["rank"]))
+            elif kind == "evict":
+                if self.elastic and self._member is not None:
+                    self._member.evict(int(data["rank"]))
+            elif kind == "topo":
+                self._topo = dict(data.get("doc") or {})
+            elif kind == "skew":
+                digest = dict(data.get("digest") or {})
+                self._skew = digest
+                self._skew_election = _skew_mod.FleetElection.seeded(
+                    digest)
+            elif kind == "endpoint":
+                self._endpoints[str(data["task"])] = dict(data["doc"])
+            elif kind == "down":
+                self._shutdown_ranks.add(int(data["rank"]))
+            elif kind == "resume":
+                self.restarts = int(data.get("restarts", self.restarts))
+
+    def _wal(self, kind: str, **data) -> None:
+        """Journal one control-plane transition (no-op when the WAL is
+        off). Callers invoke this BEFORE acting on the transition —
+        the journal is write-ahead, so a crash between journal and
+        action replays the intent, never loses it."""
+        if self._wal_log is not None:
+            self._wal_log.record(kind, **data)
+
+    def _note_resume(self, nrecords: int) -> None:
+        """Make a tracker resume observable: span + counter + flight
+        note, mirroring ``_note_transition``."""
+        from .. import telemetry
+        from ..telemetry import flight
+        telemetry.count("tracker.resume", provenance="tracker")
+        telemetry.record_span("tracker.resume", 0.0, op="resume",
+                              provenance="tracker",
+                              records=nrecords, restarts=self.restarts)
+        flight.note("tracker_resume",
+                    f"replayed {nrecords} WAL records, restart "
+                    f"#{self.restarts}, epoch {self._epoch}")
+        print(f"[tracker] resumed from WAL ({nrecords} records, "
+              f"restart #{self.restarts}, epoch {self._epoch}, "
+              f"{len(self._ranks)} known ranks)",
+              file=sys.stderr, flush=True)
+
+    def wal_records(self) -> int:
+        """Journaled transitions so far (0 when the WAL is off)."""
+        return 0 if self._wal_log is None else self._wal_log.records_total
+
+    def in_resume_grace(self) -> bool:
+        """True while poll-miss eviction evidence is waived after a
+        resume (workers are still reconnecting their pollers)."""
+        return time.monotonic() < self._grace_until
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Tracker":
@@ -268,6 +385,31 @@ class Tracker:
             except Exception:
                 pass
         self._services.clear()
+        if self._wal_log is not None and not self.crashed:
+            self._wal_log.close()
+
+    def crash(self) -> None:
+        """Simulate a tracker crash (tests, chaos ``tracker_kill``):
+        the listening socket and background threads die but NOTHING is
+        flushed, closed gracefully, or reaped — exactly the state a
+        SIGKILL leaves behind, minus the process exit. The WAL stays
+        as the dead incarnation left it (every record was already
+        fsynced on append), ready for a ``resume=True`` successor on
+        the same pinned port."""
+        self.crashed = True
+        self._done.set()
+        self._poll_stop.set()
+        if self._metrics_server is not None:
+            self._metrics_server.stop()
+            self._metrics_server = None
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        # deliberately NOT closed/reaped: the WAL file handle and any
+        # coordination services — a real crash wouldn't either
+        with self._cv:
+            self._cv.notify_all()  # unblock parked joiners
 
     def service_count(self) -> int:
         """Live coordination services (bounded: old epochs are reaped)."""
@@ -396,6 +538,16 @@ class Tracker:
             ("rabit_tracker_polls_total",
              "Completed endpoint poll sweeps.", "counter", [({}, polls)]),
         ]
+        if self._wal_log is not None:
+            gauges.append((
+                "rabit_tracker_restarts_total",
+                "Tracker crash-resume cycles (WAL replay + live-world "
+                "re-adoption).", "counter", [({}, self.restarts)]))
+            gauges.append((
+                "rabit_wal_records_total",
+                "Control-plane transitions journaled to the tracker "
+                "write-ahead log.", "counter",
+                [({}, self._wal_log.records_total)]))
         if self.elastic:
             with self._lock:
                 world_now = self._member.world()
@@ -475,6 +627,15 @@ class Tracker:
                         self._metrics[tid] = doc
                         self._endpoint_misses[tid] = 0
                     continue
+                # post-resume grace (ISSUE 10): right after a tracker
+                # resume every poller in the fleet is still timing out
+                # against the OLD incarnation's cadence — silence here
+                # is evidence of the tracker's outage, not the
+                # worker's. Waive it until the grace window closes.
+                if self.in_resume_grace():
+                    with self._lock:
+                        self._endpoint_misses[tid] = 0
+                    continue
                 # poll evidence of a partition: an endpoint that HAS
                 # answered before and now stays silent for several
                 # sweeps is indistinguishable from a dead rank to the
@@ -504,6 +665,12 @@ class Tracker:
             if self._skew_election is None:
                 self._skew_election = skew.FleetElection()
             digest = self._skew_election.fold(raw)
+            if digest is not None and \
+                    digest.get("epoch") != self._skew.get("epoch"):
+                # journal VERDICTS, not sweeps: the digest's epoch
+                # bumps exactly when the election changes, so the WAL
+                # grows with decisions rather than with poll cadence
+                self._wal("skew", digest=digest)
             with self._lock:
                 self._last_straggler = strag
                 if digest is not None:
@@ -609,11 +776,16 @@ class Tracker:
                 ok = (isinstance(doc, dict) and "host" in doc
                       and "port" in doc)
                 if ok:
+                    ep = {"host": str(doc["host"]),
+                          "port": int(doc["port"]),
+                          "rank": int(doc.get("rank", -1))}
+                    self._wal("endpoint", task=task_id, doc=ep)
                     with self._lock:
-                        self._endpoints[task_id] = {
-                            "host": str(doc["host"]),
-                            "port": int(doc["port"]),
-                            "rank": int(doc.get("rank", -1))}
+                        self._endpoints[task_id] = ep
+                        # a re-announce is proof of life: a stale miss
+                        # count from before a tracker outage must not
+                        # carry over into fresh eviction evidence
+                        self._endpoint_misses[task_id] = 0
                 _send_u32(conn, 1 if ok else 0)
                 conn.close()
             elif cmd == "topo":
@@ -628,6 +800,26 @@ class Tracker:
                 conn.close()
             elif cmd == "world":
                 _send_str(conn, json.dumps(self.membership_doc()))
+                conn.close()
+            elif cmd == "resume":
+                # post-restart handshake (ISSUE 10): a live worker
+                # re-presents its (task_id, stable_rank, epoch) so the
+                # resumed tracker can reconcile the replayed WAL
+                # against the world that kept running through the
+                # outage. Ack 1 = identities agree (or were adopted),
+                # 0 = mismatch — the worker should fall back to a full
+                # re-registration.
+                payload = _recv_str(conn)
+                try:
+                    doc = json.loads(payload)
+                except ValueError:
+                    doc = None
+                ok = False
+                if isinstance(doc, dict) and doc.get("rank") is not None:
+                    ok = self._resume_present(
+                        task_id, int(doc["rank"]),
+                        int(doc.get("epoch", 0)))
+                _send_u32(conn, 1 if ok else 0)
                 conn.close()
             elif cmd == "evict":
                 payload = _recv_str(conn)
@@ -652,6 +844,10 @@ class Tracker:
                 with self._lock:
                     rank = self._ranks.get(task_id)
                     if rank is not None:
+                        # journaled so a tracker resumed mid-teardown
+                        # still sees the job complete (a worker only
+                        # ever sends shutdown once)
+                        self._wal("down", rank=rank)
                         self._shutdown_ranks.add(rank)
                     # an elastic job is done when the LIVE world is
                     # down — evicted ranks never send shutdown
@@ -699,6 +895,8 @@ class Tracker:
         if not expected or not expected <= set(self._pending):
             return None
         batch = {r: self._pending.pop(r) for r in expected}
+        self._wal("epoch", epoch=self._epoch + 1,
+                  members=sorted(batch))
         self._epoch += 1
         if self.elastic:
             admitted = self._member.formed(batch)
@@ -707,6 +905,27 @@ class Tracker:
                                       f"{self._epoch}")
         self._cv.notify_all()
         return batch, self._epoch
+
+    def _resume_present(self, task_id: str, rank: int,
+                        epoch: int) -> bool:
+        """Reconcile one worker's post-restart ``resume`` handshake
+        against the replayed WAL: a matching identity confirms the
+        journal, an unknown task_id is adopted (a torn WAL tail can
+        lose the final pre-crash assignment — the live worker IS the
+        authority on its own rank), and a contradiction is refused so
+        the worker falls back to full re-registration."""
+        with self._lock:
+            known = self._ranks.get(task_id)
+            if known is None and 0 <= rank < self.nworkers \
+                    and rank not in self._ranks.values():
+                self._wal("assign", task=task_id, rank=rank)
+                self._ranks[task_id] = rank
+                known = rank
+            ok = known == rank and epoch <= self._epoch + 1
+            if ok:
+                self._endpoint_misses[task_id] = 0
+                self._resumed_ranks.add(rank)
+        return ok
 
     def _register(self, conn, task_id: str, host: str, port: int,
                   flags: int = 0, token: str = "",
@@ -722,6 +941,7 @@ class Tracker:
                     # can grow back to target (and the newcomer inherits
                     # that rank's durable checkpoint shard directory)
                     rank = min(self._member.evicted)
+                self._wal("assign", task=task_id, rank=rank)
                 self._ranks[task_id] = rank
             rank = self._ranks[task_id]
             if rank >= self.nworkers:
@@ -733,6 +953,7 @@ class Tracker:
                         (m.live and rank not in m.live):
                     # (re-)admission: parked until the epoch boundary —
                     # a joiner must never perturb an in-flight world
+                    self._wal("park", rank=rank)
                     m.park(rank)
                     grace_s = _membership.join_grace_ms() / 1e3 or None
             self._shutdown_ranks.discard(rank)
@@ -797,6 +1018,9 @@ class Tracker:
             return False
         rank = int(rank)
         with self._cv:
+            if rank in self._member.evicted:
+                return False
+            self._wal("evict", rank=rank, reason=reason)
             if not self._member.evict(rank):
                 return False
             pend = self._pending.pop(rank, None)
@@ -874,13 +1098,15 @@ class Tracker:
             c, h, p, f, tok = batch[rank]
             by_host.setdefault(_src_ip(c) or h, []).append(slot_of[rank])
         groups = list(by_host.values())
+        topo = {
+            "epoch": epoch,
+            "groups": groups,
+            "delegates": [min(g) for g in groups],
+            "single_host": single_host,
+        }
+        self._wal("topo", doc=topo)
         with self._lock:
-            self._topo = {
-                "epoch": epoch,
-                "groups": groups,
-                "delegates": [min(g) for g in groups],
-                "single_host": single_host,
-            }
+            self._topo = topo
         for rank in sorted(slot_of.values()):
             conn = conns[rank]
             parent, children = tree_neighbors(rank, world)
@@ -943,3 +1169,36 @@ class Tracker:
         # no client of an epoch < N exists anywhere -> reap old services
         if all_acked:
             self._reap_old_services(epoch)
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    """Standalone tracker CLI. ``--wal-dir`` journals every
+    control-plane transition; ``--resume <wal_dir>`` replays it and
+    re-adopts a live world after a crash — pin ``--host``/``--port``
+    to the dead incarnation's address so the env the workers were
+    launched with stays valid (ISSUE 10)."""
+    import argparse
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--wal-dir", default=None,
+                    help="journal control-plane transitions here "
+                         "(also RABIT_TRACKER_WAL_DIR)")
+    ap.add_argument("--resume", metavar="WAL_DIR", default=None,
+                    help="replay WAL_DIR and re-adopt the live world")
+    args = ap.parse_args(argv)
+    tr = Tracker(args.num_workers, host=args.host, port=args.port,
+                 wal_dir=args.resume or args.wal_dir,
+                 resume=args.resume is not None).start()
+    print(f"[tracker] listening on {tr.host}:{tr.port}",
+          file=sys.stderr, flush=True)
+    try:
+        tr.join()
+    finally:
+        tr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

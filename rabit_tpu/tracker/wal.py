@@ -1,0 +1,387 @@
+"""Append-only write-ahead log for the tracker control plane (ISSUE 10
+tentpole).
+
+PRs 7-9 made the tracker the authority for topology, skew elections and
+elastic membership — all of it held only in memory, so tracker death
+killed the job even though every worker and the whole data plane were
+healthy ("Highly Available Data Parallel ML training on Mesh Networks",
+arXiv:2011.03605, makes the case that the control plane must survive
+component loss independently of the data plane). This module is the
+durability half of the fix: every control-plane state transition the
+tracker commits (rank assignment, epoch advance, membership decision,
+topology doc, skew verdict, endpoint announce) is journaled here BEFORE
+it takes effect, and a restarted tracker replays the journal to re-adopt
+the live world (``tracker.py`` ``resume=True``) without restarting any
+worker.
+
+File format (all integers little-endian)::
+
+    8s   file magic "RBTWAL01"        (version-prefixed: bump on change)
+    then zero or more records, each:
+      I  len(payload)
+      I  crc32(payload)
+      ...payload: canonical JSON {"seq": n, "kind": str, "data": {...}}
+
+``seq`` starts at 1 and increments by exactly 1 per record — replay is
+deterministic and any reordering or splice is detected as corruption.
+
+Durability rules follow ``engine/ckpt_store.py``:
+
+- a FRESH log is created as ``.tmp-<pid>`` (header only), fsynced,
+  ``os.replace``d onto the final name, and the directory fsynced — a
+  crash mid-create never leaves a half-written header behind;
+- every :meth:`WriteAheadLog.record` appends frame+payload in one write
+  and fsyncs before returning, so a transition the tracker acted on is
+  on disk first (write-AHEAD, not write-behind);
+- replay truncates a torn TAIL (a crash mid-append: short frame, short
+  payload, or a CRC-bad FINAL record) back to the last intact record —
+  that is the expected crash signature and loses only the transition
+  that never completed;
+- a CRC-bad or out-of-sequence record with MORE records after it is not
+  a torn tail, it is silent middle-of-file corruption: replay raises
+  :class:`WalCorruptError` instead of resuming from a lie;
+- a magic with the right ``RBTWAL`` family but a different version
+  raises :class:`WalVersionError` (an old tracker must not misparse a
+  new journal, or vice versa).
+
+Stdlib-only, no tracker imports — the tracker depends on this module,
+never the reverse (the ``--smoke`` CLI imports the tracker lazily).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+MAGIC = b"RBTWAL01"
+_MAGIC_FAMILY = b"RBTWAL"
+_FRAME = struct.Struct("<II")
+LOG_NAME = "tracker.wal"
+# a frame claiming more than this is treated as corruption even when
+# bytes remain: no tracker transition serializes to megabytes, and the
+# cap keeps a flipped length bit from provoking a giant read
+MAX_RECORD_BYTES = 16 << 20
+
+WAL_DIR_ENV = "RABIT_TRACKER_WAL_DIR"
+
+
+class WalError(RuntimeError):
+    """Base class for journal failures."""
+
+
+class WalVersionError(WalError):
+    """The file is a rabit tracker WAL of a different format version."""
+
+
+class WalCorruptError(WalError):
+    """Non-tail corruption: a damaged or out-of-sequence record with
+    intact records after it. Resuming past it would replay a forged
+    history, so this is a hard error."""
+
+
+def _fsync_dir(path: str) -> None:
+    """Make a rename durable (rename durability is not implied by file
+    durability on POSIX)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:  # pragma: no cover - e.g. non-POSIX dir semantics
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def encode_record(seq: int, kind: str, data: Dict[str, Any]) -> bytes:
+    """Frame one journal record (canonical JSON payload: sorted keys,
+    no whitespace — replay determinism is byte determinism)."""
+    payload = json.dumps({"seq": int(seq), "kind": str(kind),
+                          "data": data},
+                         sort_keys=True, separators=(",", ":")).encode()
+    return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+class WriteAheadLog:
+    """One tracker's append-only journal under ``root``.
+
+    ``open(resume=False)`` creates a fresh log (atomically, replacing
+    any previous one); ``open(resume=True)`` replays the existing log —
+    truncating a torn tail, raising on deeper corruption — and reopens
+    it for append so the resumed tracker keeps journaling into the same
+    history. All appends are serialized under an internal lock and
+    fsynced before :meth:`record` returns.
+    """
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.path = os.path.join(self.root, LOG_NAME)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._seq = 0
+        self.records_total = 0
+        self.truncated_bytes = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def open(self, resume: bool = False) -> List[Tuple[str, dict]]:
+        """Open the journal; returns the replayed ``(kind, data)`` list
+        (empty for a fresh log)."""
+        os.makedirs(self.root, exist_ok=True)
+        if not resume:
+            tmp = os.path.join(self.root, f".tmp-{os.getpid()}")
+            with open(tmp, "wb") as f:
+                f.write(MAGIC)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+            _fsync_dir(self.root)
+            self._fh = open(self.path, "ab")
+            self._seq = 0
+            self.records_total = 0
+            return []
+        records, end = self._scan()
+        size = os.path.getsize(self.path)
+        if end < size:
+            # torn tail: a crash mid-append left a partial frame or a
+            # CRC-bad final record — drop it and resume from the last
+            # intact transition
+            self.truncated_bytes = size - end
+            os.truncate(self.path, end)
+        self._fh = open(self.path, "ab")
+        self._seq = len(records)
+        self.records_total = len(records)
+        return records
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
+
+    # -- append -----------------------------------------------------------
+    def record(self, kind: str, **data: Any) -> int:
+        """Append one transition and fsync; returns its ``seq``. The
+        caller must not act on the transition until this returns — the
+        journal is write-AHEAD."""
+        with self._lock:
+            if self._fh is None:
+                raise WalError("journal is not open")
+            self._seq += 1
+            blob = encode_record(self._seq, kind, data)
+            self._fh.write(blob)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self.records_total += 1
+            return self._seq
+
+    # -- replay -----------------------------------------------------------
+    def replay(self) -> List[Tuple[str, dict]]:
+        """Parse the journal without opening it for append (tools,
+        tests). Same torn-tail / corruption rules as ``open``."""
+        return self._scan()[0]
+
+    def _scan(self) -> Tuple[List[Tuple[str, dict]], int]:
+        """Returns ``(records, clean_end_offset)``; raises
+        :class:`WalVersionError` / :class:`WalCorruptError`."""
+        if not os.path.exists(self.path):
+            raise WalError(f"no journal at {self.path}")
+        with open(self.path, "rb") as f:
+            blob = f.read()
+        if len(blob) < len(MAGIC) or blob[:len(MAGIC)] != MAGIC:
+            if blob[:len(_MAGIC_FAMILY)] == _MAGIC_FAMILY:
+                raise WalVersionError(
+                    f"journal {self.path} has version "
+                    f"{blob[:len(MAGIC)]!r}, this build reads {MAGIC!r}")
+            raise WalCorruptError(
+                f"journal {self.path} has bad magic {blob[:8]!r}")
+        records: List[Tuple[str, dict]] = []
+        off = len(MAGIC)
+        while off < len(blob):
+            if off + _FRAME.size > len(blob):
+                return records, off  # torn frame at the tail
+            length, crc = _FRAME.unpack_from(blob, off)
+            start = off + _FRAME.size
+            end = start + length
+            if length > MAX_RECORD_BYTES:
+                raise WalCorruptError(
+                    f"record at offset {off} claims {length} bytes")
+            if end > len(blob):
+                return records, off  # torn payload at the tail
+            payload = blob[start:end]
+            bad: Optional[str] = None
+            doc = None
+            if zlib.crc32(payload) != crc:
+                bad = "CRC mismatch"
+            else:
+                try:
+                    doc = json.loads(payload)
+                except ValueError:
+                    bad = "unparseable payload"
+                else:
+                    if not isinstance(doc, dict) or \
+                            doc.get("seq") != len(records) + 1 or \
+                            not isinstance(doc.get("kind"), str) or \
+                            not isinstance(doc.get("data"), dict):
+                        bad = (f"bad sequence/shape "
+                               f"(want seq {len(records) + 1})")
+            if bad is not None:
+                if end >= len(blob):
+                    return records, off  # damaged FINAL record: torn tail
+                raise WalCorruptError(
+                    f"record {len(records) + 1} at offset {off}: {bad} "
+                    f"with {len(blob) - end} intact bytes after it")
+            records.append((doc["kind"], doc["data"]))
+            off = end
+        return records, off
+
+
+# ------------------------------------------------------------- CI smoke
+
+
+def _smoke() -> None:
+    """CI contract (run_tests.sh tier 0i): record/replay determinism,
+    torn-tail truncation, corrupt-middle hard error — then a LIVE
+    tracker journals a 2-rank formation, crashes without cleanup, and a
+    ``resume=True`` tracker on the same port re-adopts the world (same
+    ranks, same epoch, zero re-registrations)."""
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="rabit-wal-smoke-")
+    try:
+        # determinism: record -> replay -> identical (kind, data) list
+        w = WriteAheadLog(root)
+        w.open()
+        wrote = [("assign", {"task": "0", "rank": 0}),
+                 ("epoch", {"epoch": 1}),
+                 ("skew", {"digest": {"epoch": 1, "laggard": 1}})]
+        for kind, data in wrote:
+            w.record(kind, **data)
+        w.close()
+        assert WriteAheadLog(root).replay() == wrote
+
+        # torn tail: a partial final frame is truncated, not fatal
+        with open(os.path.join(root, LOG_NAME), "ab") as f:
+            f.write(b"\x40\x00\x00\x00\xde\xad")
+        w2 = WriteAheadLog(root)
+        assert w2.open(resume=True) == wrote and w2.truncated_bytes == 6
+        w2.record("epoch", epoch=2)
+        assert w2._seq == len(wrote) + 1
+        w2.close()
+
+        # corrupt middle record (CRC flip with intact bytes after it)
+        # is a hard error, never a silent resume
+        path = os.path.join(root, LOG_NAME)
+        with open(path, "rb") as f:
+            blob = bytearray(f.read())
+        blob[len(MAGIC) + _FRAME.size + 2] ^= 0xFF
+        with open(path, "wb") as f:
+            f.write(blob)
+        try:
+            WriteAheadLog(root).replay()
+        except WalCorruptError:
+            pass
+        else:
+            raise AssertionError("corrupt middle record not detected")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # live round: journal a formation, crash, resume on the SAME port
+    import socket
+    import struct as _struct
+
+    from .tracker import MAGIC as WIRE_MAGIC, Tracker
+
+    root = tempfile.mkdtemp(prefix="rabit-wal-smoke-")
+
+    def register(tr, task):
+        c = socket.create_connection(  # noqa: R001 - smoke-only client
+            (tr.host, tr.port), timeout=10)
+        c.settimeout(30)
+        for v in (WIRE_MAGIC,):
+            c.sendall(_struct.pack("<I", v))
+        for s in ("start", task):
+            b = s.encode()
+            c.sendall(_struct.pack("<I", len(b)) + b)
+        c.sendall(_struct.pack("<I", 0))
+        b = b"127.0.0.1"
+        c.sendall(_struct.pack("<I", len(b)) + b)
+        c.sendall(_struct.pack("<I", 9000 + int(task)))
+        c.sendall(_struct.pack("<I", 0))
+        c.sendall(_struct.pack("<I", 0))  # empty uds_token
+        return c
+
+    def drain_assignment(c):
+        def u32():
+            out = b""
+            while len(out) < 4:
+                chunk = c.recv(4 - len(out))
+                assert chunk, "tracker closed mid-assignment"
+                out += chunk
+            return _struct.unpack("<I", out)[0]
+
+        def skip_str():
+            n = u32()
+            got = 0
+            while got < n:
+                got += len(c.recv(n - got))
+
+        rank, world, epoch = u32(), u32(), u32()
+        skip_str(); u32(); u32(); u32()
+        for _ in range(u32()):
+            u32()
+        u32(); u32()
+        for _ in range(u32()):
+            u32(); skip_str(); u32(); skip_str()
+        u32()
+        c.sendall(_struct.pack("<I", 1))  # ready ack
+        c.close()
+        return rank, world, epoch
+
+    tr = Tracker(2, wal_dir=root).start()
+    try:
+        conns = [register(tr, str(i)) for i in range(2)]
+        got = sorted(drain_assignment(c) for c in conns)
+        assert got == [(0, 2, 1), (1, 2, 1)], got
+        port = tr.port
+        tr.crash()  # no graceful flush, no journal close
+
+        import time
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                res = Tracker(2, host=tr.host, port=port, wal_dir=root,
+                              resume=True)
+                break
+            except OSError:
+                # the dead incarnation's listen socket can linger a
+                # beat past crash(); the pinned port must win
+                assert time.monotonic() < deadline, "port never freed"
+                time.sleep(0.05)
+        res.start()
+        try:
+            assert res.port == port
+            assert res._ranks == {"0": 0, "1": 1}, res._ranks
+            assert res._epoch == 1, res._epoch
+            assert res.restarts == 1, res.restarts
+            assert res.wal_records() > 0
+        finally:
+            res.stop()
+    finally:
+        tr.stop()
+        shutil.rmtree(root, ignore_errors=True)
+    print("wal smoke ok")
+
+
+if __name__ == "__main__":
+    import sys
+    if "--smoke" in sys.argv:
+        _smoke()
+    else:
+        print(__doc__)

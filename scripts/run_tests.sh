@@ -62,6 +62,13 @@ echo "== tier 0h: elastic-membership smoke (evict -> shrink -> rejoin) =="
 # boundary, and re-admit it back to N — pure control plane, no jax
 python -m rabit_tpu.tracker.membership --smoke
 
+echo "== tier 0i: tracker-WAL smoke (journal -> crash -> resume) =="
+# WAL format round-trip (torn-tail truncation, corrupt-middle hard
+# error), then a live tracker journals a formation, crashes without
+# cleanup, and a resume=True successor on the same port re-adopts the
+# world — plus the chaos tracker_kill hook path (part of tier 0c)
+python -m rabit_tpu.tracker.wal --smoke
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

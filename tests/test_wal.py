@@ -1,0 +1,613 @@
+"""Crash-recoverable tracker (ISSUE 10): WAL format battery, tracker
+journal -> crash -> resume re-adoption, the ``resume`` wire handshake,
+the post-resume grace window, WAL-off byte-identity, the skew-poller
+breaker fix, chaos ``tracker_kill``, and lint rule R003."""
+
+import ast
+import json
+import os
+import socket
+import struct
+import sys
+import time
+import zlib
+
+import pytest
+
+from rabit_tpu.tracker import wal as wal_mod
+from rabit_tpu.tracker.wal import (
+    LOG_NAME, MAGIC, WalCorruptError, WalError, WalVersionError,
+    WriteAheadLog, encode_record)
+from rabit_tpu.tracker.tracker import MAGIC as WIRE_MAGIC, Tracker
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------- helpers
+
+def _send_u32(s, v):
+    s.sendall(struct.pack("<I", v))
+
+
+def _send_str(s, txt):
+    b = txt.encode()
+    _send_u32(s, len(b))
+    s.sendall(b)
+
+
+def _recv_all(s, n):
+    out = b""
+    while len(out) < n:
+        chunk = s.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("closed")
+        out += chunk
+    return out
+
+
+def _recv_u32(s):
+    return struct.unpack("<I", _recv_all(s, 4))[0]
+
+
+def _recv_str(s):
+    return _recv_all(s, _recv_u32(s)).decode()
+
+
+class FakeWorker:
+    """Minimal speaker of the worker->tracker registration protocol."""
+
+    def __init__(self, tracker, task_id, cmd="start"):
+        self.sock = socket.create_connection((tracker.host, tracker.port),
+                                             timeout=10)
+        _send_u32(self.sock, WIRE_MAGIC)
+        _send_str(self.sock, cmd)
+        _send_str(self.sock, task_id)
+        _send_u32(self.sock, 0)          # num_attempt
+        _send_str(self.sock, "127.0.0.1")
+        _send_u32(self.sock, 9999)
+        _send_u32(self.sock, 0)          # flags
+        _send_str(self.sock, "")         # uds token
+
+    def read_assignment(self):
+        s = self.sock
+        out = {"rank": _recv_u32(s), "world": _recv_u32(s),
+               "epoch": _recv_u32(s), "coord_host": _recv_str(s),
+               "coord_port": _recv_u32(s),
+               "single_host": _recv_u32(s), "parent": _recv_u32(s)}
+        ntree = _recv_u32(s)
+        out["tree"] = [_recv_u32(s) for _ in range(ntree)]
+        out["ring_prev"], out["ring_next"] = _recv_u32(s), _recv_u32(s)
+        nconn = _recv_u32(s)
+        for _ in range(nconn):
+            _recv_u32(s), _recv_str(s), _recv_u32(s), _recv_str(s)
+        out["naccept"] = _recv_u32(s)
+        return out
+
+    def ack(self):
+        _send_u32(self.sock, 1)
+
+    def close(self):
+        self.sock.close()
+
+
+def _form_world(tr, n=2):
+    """Register n FakeWorkers, drain + ack; returns the assignments."""
+    workers = [FakeWorker(tr, str(i)) for i in range(n)]
+    got = [w.read_assignment() for w in workers]
+    for w in workers:
+        w.ack()
+        w.close()
+    return sorted(g["rank"] for g in got), got
+
+
+def _wire_cmd(tr, cmd, task_id="0", payload=None):
+    """One raw tracker round-trip; returns the open socket."""
+    c = socket.create_connection((tr.host, tr.port), timeout=10)
+    _send_u32(c, WIRE_MAGIC)
+    _send_str(c, cmd)
+    _send_str(c, task_id)
+    _send_u32(c, 0)
+    if payload is not None:
+        _send_str(c, payload)
+    return c
+
+
+def _resume_tracker(dead, root, **kw):
+    """``Tracker(resume=True)`` pinned to the dead incarnation's port,
+    absorbing the briefly-lingering listen socket (Errno 98)."""
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            return Tracker(dead.nworkers, host=dead.host, port=dead.port,
+                           wal_dir=root, resume=True, **kw)
+        except OSError:
+            assert time.monotonic() < deadline, "port never freed"
+            time.sleep(0.05)
+
+
+# ----------------------------------------------------------- WAL battery
+
+def test_record_replay_roundtrip(tmp_path):
+    w = WriteAheadLog(str(tmp_path))
+    w.open()
+    wrote = [("assign", {"task": "a", "rank": 0}),
+             ("epoch", {"epoch": 1, "members": [0]}),
+             ("topo", {"doc": {"hosts": ["h"]}}),
+             ("skew", {"digest": {"epoch": 1, "laggard": 2}})]
+    seqs = [w.record(kind, **data) for kind, data in wrote]
+    assert seqs == [1, 2, 3, 4]
+    assert w.records_total == 4
+    w.close()
+    assert WriteAheadLog(str(tmp_path)).replay() == wrote
+
+
+def test_fresh_open_replaces_existing(tmp_path):
+    w = WriteAheadLog(str(tmp_path))
+    w.open()
+    w.record("assign", task="a", rank=0)
+    w.close()
+    w2 = WriteAheadLog(str(tmp_path))
+    assert w2.open(resume=False) == []   # atomic re-create
+    w2.close()
+    assert WriteAheadLog(str(tmp_path)).replay() == []
+
+
+def test_encode_record_is_canonical():
+    a = encode_record(1, "epoch", {"b": 2, "a": 1})
+    b = encode_record(1, "epoch", {"a": 1, "b": 2})
+    assert a == b                        # sorted keys: byte determinism
+    length, crc = struct.unpack_from("<II", a)
+    payload = a[8:]
+    assert len(payload) == length and zlib.crc32(payload) == crc
+    assert json.loads(payload) == {"seq": 1, "kind": "epoch",
+                                   "data": {"a": 1, "b": 2}}
+
+
+@pytest.mark.parametrize("tail", [
+    b"\x40",                             # torn frame
+    struct.pack("<II", 64, 0xDEAD),      # frame but no payload
+    struct.pack("<II", 8, 0xDEAD) + b"shrt",  # short payload
+])
+def test_torn_tail_truncated_and_appendable(tmp_path, tail):
+    w = WriteAheadLog(str(tmp_path))
+    w.open()
+    w.record("assign", task="a", rank=0)
+    w.close()
+    with open(os.path.join(str(tmp_path), LOG_NAME), "ab") as f:
+        f.write(tail)
+    w2 = WriteAheadLog(str(tmp_path))
+    assert w2.open(resume=True) == [("assign", {"task": "a", "rank": 0})]
+    assert w2.truncated_bytes == len(tail)
+    assert w2.record("epoch", epoch=1) == 2   # seq continues cleanly
+    w2.close()
+    assert WriteAheadLog(str(tmp_path)).replay() == [
+        ("assign", {"task": "a", "rank": 0}), ("epoch", {"epoch": 1})]
+
+
+def test_crc_bad_final_record_is_a_torn_tail(tmp_path):
+    w = WriteAheadLog(str(tmp_path))
+    w.open()
+    w.record("assign", task="a", rank=0)
+    w.record("epoch", epoch=1)
+    w.close()
+    path = os.path.join(str(tmp_path), LOG_NAME)
+    blob = bytearray(open(path, "rb").read())
+    blob[-1] ^= 0xFF                     # damage the FINAL record only
+    open(path, "wb").write(bytes(blob))
+    w2 = WriteAheadLog(str(tmp_path))
+    assert w2.open(resume=True) == [("assign", {"task": "a", "rank": 0})]
+    assert w2.truncated_bytes > 0
+
+
+def test_corrupt_middle_record_is_fatal(tmp_path):
+    w = WriteAheadLog(str(tmp_path))
+    w.open()
+    w.record("assign", task="a", rank=0)
+    w.record("epoch", epoch=1)
+    w.close()
+    path = os.path.join(str(tmp_path), LOG_NAME)
+    blob = bytearray(open(path, "rb").read())
+    blob[len(MAGIC) + 8 + 2] ^= 0xFF     # first record's payload
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(WalCorruptError):
+        WriteAheadLog(str(tmp_path)).replay()
+
+
+def test_out_of_sequence_record_is_fatal(tmp_path):
+    path = os.path.join(str(tmp_path), LOG_NAME)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(encode_record(1, "assign", {"task": "a", "rank": 0}))
+        f.write(encode_record(3, "epoch", {"epoch": 1}))  # skips seq 2
+        f.write(encode_record(3, "epoch", {"epoch": 2}))  # ...not a tail
+    with pytest.raises(WalCorruptError):
+        WriteAheadLog(str(tmp_path)).replay()
+
+
+def test_version_skew_is_fatal(tmp_path):
+    path = os.path.join(str(tmp_path), LOG_NAME)
+    with open(path, "wb") as f:
+        f.write(b"RBTWAL99")
+    with pytest.raises(WalVersionError):
+        WriteAheadLog(str(tmp_path)).replay()
+    with open(path, "wb") as f:
+        f.write(b"notawal!")
+    with pytest.raises(WalCorruptError):
+        WriteAheadLog(str(tmp_path)).replay()
+
+
+def test_missing_journal_raises(tmp_path):
+    with pytest.raises(WalError):
+        WriteAheadLog(str(tmp_path)).replay()
+
+
+def test_giant_length_claim_is_corruption(tmp_path):
+    path = os.path.join(str(tmp_path), LOG_NAME)
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(struct.pack("<II", wal_mod.MAX_RECORD_BYTES + 1, 0))
+        f.write(b"\x00" * 64)
+    with pytest.raises(WalCorruptError):
+        WriteAheadLog(str(tmp_path)).replay()
+
+
+# ------------------------------------------- tracker journal -> resume
+
+def test_tracker_journals_formation(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    try:
+        ranks, _ = _form_world(tr, 2)
+        assert ranks == [0, 1]
+        assert tr.wal_records() > 0
+    finally:
+        tr.stop()
+    kinds = [k for k, _ in WriteAheadLog(str(tmp_path)).replay()]
+    assert kinds.count("assign") == 2
+    assert "epoch" in kinds and "topo" in kinds
+
+
+def test_crash_resume_readopts_world(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    res = None
+    try:
+        _form_world(tr, 2)
+        tr.crash()
+        res = _resume_tracker(tr, str(tmp_path)).start()
+        assert res.port == tr.port        # pinned address
+        assert res._ranks == {"0": 0, "1": 1}
+        assert res._epoch == 1
+        assert res.restarts == 1
+        # a second crash/resume keeps counting
+        res.crash()
+        res2 = _resume_tracker(res, str(tmp_path)).start()
+        try:
+            assert res2.restarts == 2
+            assert res2._ranks == {"0": 0, "1": 1}
+        finally:
+            res2.stop()
+    finally:
+        if res is not None:
+            res.stop()
+        tr.stop()
+
+
+def test_resume_handshake_reconciles_and_refuses(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    res = None
+    try:
+        _form_world(tr, 2)
+        tr.crash()
+        res = _resume_tracker(tr, str(tmp_path)).start()
+        # matching identity -> ack 1
+        c = _wire_cmd(res, "resume", "0",
+                      json.dumps({"rank": 0, "epoch": 1}))
+        assert _recv_u32(c) == 1
+        c.close()
+        # contradicting rank -> ack 0 (worker falls back to re-register)
+        c = _wire_cmd(res, "resume", "0",
+                      json.dumps({"rank": 1, "epoch": 1}))
+        assert _recv_u32(c) == 0
+        c.close()
+        # a from-the-future epoch -> ack 0
+        c = _wire_cmd(res, "resume", "1",
+                      json.dumps({"rank": 1, "epoch": 99}))
+        assert _recv_u32(c) == 0
+        c.close()
+    finally:
+        if res is not None:
+            res.stop()
+        tr.stop()
+
+
+def test_resume_adopts_identity_lost_to_torn_tail(tmp_path):
+    """A torn WAL tail can lose the final pre-crash assignment; the
+    live worker re-presenting it is the authority and gets adopted."""
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    res = None
+    try:
+        _form_world(tr, 2)
+        tr.crash()
+        res = _resume_tracker(tr, str(tmp_path)).start()
+        del res._ranks["1"]               # simulate the lost record
+        c = _wire_cmd(res, "resume", "1",
+                      json.dumps({"rank": 1, "epoch": 1}))
+        assert _recv_u32(c) == 1
+        c.close()
+        assert res._ranks["1"] == 1       # re-journaled via assign
+    finally:
+        if res is not None:
+            res.stop()
+        tr.stop()
+
+
+def test_resume_grace_window(tmp_path, monkeypatch):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    res = None
+    try:
+        _form_world(tr, 2)
+        tr.crash()
+        monkeypatch.setenv("RABIT_TRACKER_RESUME_GRACE_MS", "60000")
+        res = _resume_tracker(tr, str(tmp_path)).start()
+        assert res.in_resume_grace()
+        # a cold (non-resumed) tracker never opens the window
+        assert not tr.in_resume_grace()
+    finally:
+        if res is not None:
+            res.stop()
+        tr.stop()
+
+
+def test_resume_grace_zero_disables_window(tmp_path, monkeypatch):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    res = None
+    try:
+        _form_world(tr, 2)
+        tr.crash()
+        monkeypatch.setenv("RABIT_TRACKER_RESUME_GRACE_MS", "0")
+        res = _resume_tracker(tr, str(tmp_path)).start()
+        assert not res.in_resume_grace()
+    finally:
+        if res is not None:
+            res.stop()
+        tr.stop()
+
+
+def test_wal_off_is_byte_identical(tmp_path):
+    """With no WAL dir the tracker journals nothing, writes nothing,
+    and serves the exact same assignments."""
+    plain = Tracker(2).start()
+    waled = Tracker(2, wal_dir=str(tmp_path)).start()
+    try:
+        _, got_plain = _form_world(plain, 2)
+        _, got_waled = _form_world(waled, 2)
+        strip = ("coord_host", "coord_port")  # per-instance only
+        for a, b in zip(sorted(got_plain, key=lambda g: g["rank"]),
+                        sorted(got_waled, key=lambda g: g["rank"])):
+            assert {k: v for k, v in a.items() if k not in strip} == \
+                   {k: v for k, v in b.items() if k not in strip}
+        assert plain._wal_log is None
+        assert plain.wal_records() == 0
+        assert not plain.in_resume_grace()
+    finally:
+        plain.stop()
+        waled.stop()
+    assert os.listdir(str(tmp_path)) == [LOG_NAME]  # only the WAL'd one
+
+
+def test_shutdown_journaled_across_resume(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    res = None
+    try:
+        _form_world(tr, 2)
+        c = _wire_cmd(tr, "shutdown", "0")
+        c.close()
+        time.sleep(0.2)
+        tr.crash()
+        res = _resume_tracker(tr, str(tmp_path)).start()
+        assert 0 in res._shutdown_ranks   # replayed "down" record
+    finally:
+        if res is not None:
+            res.stop()
+        tr.stop()
+
+
+# -------------------------------------------------- skew breaker fix
+
+def test_fetch_skew_raw_splits_unreachable_from_empty():
+    from rabit_tpu.telemetry.skew import _fetch_skew_raw
+    # unreachable: nothing listens on a fresh ephemeral port
+    probe = socket.socket()
+    probe.bind(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+    reached, d = _fetch_skew_raw("127.0.0.1", port, timeout=0.3)
+    assert (reached, d) == (False, None)
+    # alive tracker with NO digest yet: reached=True, digest=None —
+    # the distinction the breaker re-arm rides on
+    tr = Tracker(2).start()
+    try:
+        reached, d = _fetch_skew_raw(tr.host, tr.port, timeout=2.0)
+        assert (reached, d) == (True, None)
+    finally:
+        tr.stop()
+
+
+def test_breaker_rearms_on_round_trip(monkeypatch):
+    """A tripped poller must reset its breaker on the first successful
+    round trip even when the resumed tracker serves no digest yet, and
+    must fire the reconnect hook exactly once per outage."""
+    from rabit_tpu.telemetry import skew
+
+    mon = skew.SkewMonitor()
+    mon._misses = skew.BREAKER_FAILURES + 2
+    assert mon.breaker_state()["tripped"]
+    hooks = []
+    monkeypatch.setattr(mon, "_on_reconnect", lambda: hooks.append(1))
+
+    # replicate the poll step with a reached-but-empty round trip
+    reached, d = True, None
+    if reached:
+        with mon._lock:
+            was_tripped = mon._misses >= skew.BREAKER_FAILURES
+            mon._misses = 0
+        if was_tripped:
+            mon._on_reconnect()
+        if d is not None:
+            mon.observe(d)
+    assert not mon.breaker_state()["tripped"]
+    assert hooks == [1]
+
+
+def test_poller_reconnect_presents_resume(tmp_path, monkeypatch):
+    """End to end: a tripped SkewMonitor pointed at a resumed tracker
+    re-arms and re-presents the worker identity (the ``resume``
+    handshake lands in ``_resumed_ranks``)."""
+    from rabit_tpu.telemetry import skew
+    from rabit_tpu.tracker import membership
+
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    res = None
+    try:
+        _form_world(tr, 2)
+        tr.crash()
+        res = _resume_tracker(tr, str(tmp_path)).start()
+        monkeypatch.setenv("RABIT_TRACKER_URI", res.host)
+        monkeypatch.setenv("RABIT_TRACKER_PORT", str(res.port))
+        monkeypatch.setenv("RABIT_SKEW_TRACKER",
+                           f"{res.host}:{res.port}")
+        monkeypatch.setenv("RABIT_SKEW_POLL_MS", "50")
+        membership.note_identity("0", 0, 1)
+        mon = skew.SkewMonitor()
+        mon._misses = skew.BREAKER_FAILURES   # tripped by the outage
+        mon._ensure_poller()
+        try:
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if 0 in res._resumed_ranks and \
+                        not mon.breaker_state()["tripped"]:
+                    break
+                time.sleep(0.05)
+            assert not mon.breaker_state()["tripped"], "never re-armed"
+            assert 0 in res._resumed_ranks, "identity never re-presented"
+        finally:
+            mon._stop.set()
+    finally:
+        if res is not None:
+            res.stop()
+        tr.stop()
+
+
+# ------------------------------------------------- chaos tracker_kill
+
+def test_tracker_kill_rule_validation():
+    from rabit_tpu.chaos.schedule import Rule
+    with pytest.raises(ValueError):
+        Rule("tracker_kill")              # unanchored: would kill reg
+    assert Rule("tracker_kill", window_s=(1, 2)).max_times == 1
+    assert Rule("tracker_kill", conn=3).max_times == 1
+    assert Rule("tracker_kill", conn=3, max_times=2).max_times == 2
+    r = Rule("tracker_kill", window_s=(1, 2), target="tracker")
+    assert Rule.from_dict(r.to_dict()).to_dict() == r.to_dict()
+
+
+def test_tracker_kill_fires_hook_once(tmp_path):
+    from rabit_tpu.chaos.proxy import ChaosProxy
+    from rabit_tpu.chaos.schedule import Rule, Schedule
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    kills = []
+    sched = Schedule([Rule("tracker_kill", conn=0, delay_ms=500)])
+    with ChaosProxy(*srv.getsockname(), sched, name="kill-test",
+                    kill_hook=kills.append) as proxy:
+        for _ in range(2):
+            try:
+                c = socket.create_connection((proxy.host, proxy.port),
+                                             timeout=5)
+            except OSError:
+                continue    # the kill's RST can land mid-connect
+            try:
+                c.settimeout(2.0)
+                c.recv(1)                 # RST (killed) or timeout
+            except OSError:
+                pass
+            c.close()
+        events = [e[1] for e in proxy.events]
+    srv.close()
+    assert kills == [500.0]               # fired once, with delay_ms
+    assert events.count("tracker_kill") == 1
+
+
+def test_tracker_kill_inert_without_hook():
+    from rabit_tpu.chaos.proxy import ChaosProxy
+    from rabit_tpu.chaos.schedule import Rule, Schedule
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    sched = Schedule([Rule("tracker_kill", conn=0)])
+    with ChaosProxy(*srv.getsockname(), sched, name="inert-test") as p:
+        c = socket.create_connection((p.host, p.port), timeout=5)
+        time.sleep(0.2)
+        c.close()
+        assert p.events == []             # link proxies never kill
+        assert sched.rules[0].fired == 0  # budget not consumed
+    srv.close()
+
+
+# ------------------------------------------------------- lint rule R003
+
+def _lint():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    return lint
+
+
+def _r003(src):
+    lint = _lint()
+    return lint._r003_issues(lint.R003_FILE, ast.parse(src))
+
+
+def test_r003_flags_unjournaled_mutation():
+    issues = _r003("class T:\n"
+                   "    def set_epoch(self):\n"
+                   "        self._epoch += 1\n")
+    assert len(issues) == 1 and issues[0][2] == "R003"
+    assert "set_epoch" in issues[0][3]
+
+
+def test_r003_accepts_journaled_mutation_and_exemptions():
+    assert _r003("class T:\n"
+                 "    def set_epoch(self):\n"
+                 "        self._wal('epoch', epoch=self._epoch + 1)\n"
+                 "        self._epoch += 1\n") == []
+    assert _r003("class T:\n"
+                 "    def __init__(self):\n"
+                 "        self._epoch = 0\n"
+                 "    def _replay(self, recs):\n"
+                 "        self._ranks['a'] = 1\n"
+                 "        self._member.evict(2)\n") == []
+
+
+def test_r003_sees_aliased_member_mutators():
+    issues = _r003("class T:\n"
+                   "    def admit(self):\n"
+                   "        m = self._member\n"
+                   "        m.park(3)\n")
+    assert len(issues) == 1 and "park" in issues[0][3]
+
+
+def test_r003_clean_on_real_tracker():
+    lint = _lint()
+    path = os.path.join(ROOT, "rabit_tpu", "tracker", "tracker.py")
+    assert lint.check_file(path) == []
+
+
+def test_metric_families_registered():
+    from rabit_tpu.telemetry.prom import METRIC_FAMILIES
+    assert "rabit_tracker_restarts_total" in METRIC_FAMILIES
+    assert "rabit_wal_records_total" in METRIC_FAMILIES

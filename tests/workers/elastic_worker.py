@@ -112,14 +112,31 @@ def register(cmd):
     return rank, world, epoch
 
 
-def evict_self():
+def predecessor_rank():
+    """The stable rank the tracker assigned this task's DEAD
+    incarnation. Ranks are handed out in registration-arrival order,
+    not by task id — under load task "1" may well hold rank 2 — so
+    evicting ``int(TASK)`` can hit a live survivor and wedge the
+    world. The attempt-0 ``formed rank=R`` log line is the
+    first-party record of the real assignment."""
+    try:
+        with open(os.path.join(OUT, f"r{TASK}.log")) as f:
+            for ln in f.read().splitlines():
+                if ln.startswith("formed rank="):
+                    return int(ln.split("rank=")[1].split()[0])
+    except OSError:
+        pass
+    return int(TASK)
+
+
+def evict_self(rank):
     """Report the previous incarnation of this stable rank dead."""
     c = socket.create_connection((HOST, PORT), timeout=10)
     _send_u32(c, MAGIC)
     _send_str(c, "evict")
     _send_str(c, TASK)
     _send_u32(c, ATTEMPT)
-    _send_str(c, json.dumps({"rank": int(TASK), "reason": "restarted"}))
+    _send_str(c, json.dumps({"rank": rank, "reason": "restarted"}))
     ok = _recv_u32(c)
     c.close()
     return ok
@@ -158,7 +175,7 @@ def main():
 
     if TASK == KILL_TASK:
         # relaunched victim: first-party death evidence, then park
-        evict_self()
+        evict_self(predecessor_rank())
         log("evicted self")
         # the survivors must absorb the shrink before we re-admit, or
         # the next batch would form straight back at the target world

@@ -1,0 +1,93 @@
+"""Tracker-resume cluster worker (tests/test_tracker_resume_cluster.py).
+
+A native-engine rank that keeps computing THROUGH a tracker crash: the
+data plane rides worker-worker links, so once the world is formed the
+tracker's death must cost nothing but control-plane reachability. Each
+round allreduces a deterministic int64 payload and logs its CRC — the
+stream is bit-comparable against an uninterrupted baseline run.
+
+Between rounds the worker leans on the control plane the way a real
+job does:
+
+- a :class:`SkewMonitor` poller (RABIT_SKEW_TRACKER pointed at the
+  launcher's tracker address, i.e. the chaos proxy) polls every
+  ``RABIT_SKEW_POLL_MS`` — these accepts are what trigger the chaos
+  ``tracker_kill`` inside its window, then trip the poller's circuit
+  breaker during the outage, then re-arm it against the resumed
+  incarnation (the ISSUE 10 satellite fix), firing ``present_resume``
+  + ``reannounce`` exactly once;
+- breaker transitions are logged (``breaker tripped`` / ``breaker
+  rearmed``) so the test can assert the reconnect actually happened.
+
+The worker exits 0 only if every round's allreduce was exact — any
+rank lost mid-run would wedge or corrupt the collectives and fail the
+whole cluster.
+"""
+
+import os
+import sys
+import time
+import zlib
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+OUT = os.environ["RESUME_OUT"]
+ROUNDS = int(os.environ.get("RESUME_ROUNDS", "60"))
+ROUND_SLEEP_S = float(os.environ.get("RESUME_ROUND_SLEEP_MS", "200")) / 1e3
+TASK = os.environ.get("RABIT_TASK_ID", "?")
+
+
+def log(msg):
+    with open(os.path.join(OUT, f"r{TASK}.log"), "a") as f:
+        f.write(msg + "\n")
+
+
+def main() -> None:
+    # the skew poller is this worker's steady control-plane heartbeat;
+    # point it at the launcher-provided tracker address (the chaos
+    # proxy, when chaos fronts the tracker)
+    host = os.environ.get("RABIT_TRACKER_URI", "")
+    port = os.environ.get("RABIT_TRACKER_PORT", "")
+    if host and port:
+        os.environ["RABIT_SKEW_TRACKER"] = f"{host}:{port}"
+
+    rabit.init([a for a in sys.argv[1:] if "=" in a], engine="native")
+    rank = rabit.get_rank()
+    world = rabit.get_world_size()
+    assert rabit.is_distributed()
+    log(f"formed rank={rank} world={world}")
+
+    from rabit_tpu.telemetry import skew
+    mon = skew.monitor()
+    mon.current()  # starts the poller (RABIT_SKEW_TRACKER is set)
+    was_tripped = False
+
+    for rnd in range(ROUNDS):
+        # pure function of (round, world): int64 sums are exact, so the
+        # CRC stream is bit-identical no matter what the control plane
+        # went through mid-run
+        a = (np.arange(256, dtype=np.int64) * (rank + 1) + rnd)
+        out = rabit.allreduce(a, rabit.SUM)
+        expect = (np.arange(256, dtype=np.int64)
+                  * (world * (world + 1) // 2) + rnd * world)
+        np.testing.assert_array_equal(out, expect)
+        log(f"round={rnd} crc={zlib.crc32(out.tobytes()):08x}")
+
+        tripped = mon.breaker_state()["tripped"]
+        if tripped and not was_tripped:
+            log("breaker tripped")
+        elif was_tripped and not tripped:
+            log("breaker rearmed")
+        was_tripped = tripped
+        time.sleep(ROUND_SLEEP_S)
+
+    log("done")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

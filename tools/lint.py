@@ -47,6 +47,15 @@ this implements the highest-signal subset with only the stdlib:
   that caches schedules, groupings, digests, or counters keyed on the
   old size silently corrupts the new world unless it exposes the hook
   the engines drive on every registration-epoch transition.
+- **unjournaled tracker-state mutation** (R003, repo-specific): the
+  tracker's crash recovery replays a write-ahead log
+  (``tracker/wal.py``), so any function in ``tracker/tracker.py`` that
+  mutates journaled control-plane state (the R003_STATE attributes, or
+  membership transitions via ``.evict()``/``.park()``/``.formed()``)
+  must also call ``self._wal(...)`` — a mutation that skips the
+  journal is state a resumed tracker silently forgets. ``__init__``
+  and replay-path functions (``_replay*``) are exempt: they *are* the
+  recovery side.
 
 ``scripts/run_tests.sh`` prefers ``ruff check`` when installed; this is
 the fallback so the tier never silently no-ops. Exit 0 clean, 1 with
@@ -125,6 +134,15 @@ R002_MODULES = (
 )
 
 _R002_HOOK = "epoch_reset"
+
+# R003: crash-recovery journaling (ISSUE 10). Attributes of the Tracker
+# that the WAL replays on --resume; mutating one (or driving a
+# membership transition) without a self._wal(...) call in the same
+# function means a resumed tracker forgets that state.
+R003_FILE = os.path.join("rabit_tpu", "tracker", "tracker.py")
+R003_STATE = {"_ranks", "_topo", "_skew", "_endpoints", "_epoch"}
+_R003_MEMBER_MUTATORS = {"evict", "park", "formed"}
+_R003_EXEMPT_PREFIXES = ("_replay",)
 
 # T003: files that mint /metrics family names. Every name found here
 # (via _t003_minted_names) must be registered in prom.py's
@@ -254,6 +272,61 @@ def _r002_issues(rel, tree):
              "resizes call it on every registration-epoch transition)")]
 
 
+def _r003_mutations(fn_node):
+    """(lineno, description) for every journaled-state mutation inside
+    ``fn_node``: a store/augassign to a R003_STATE attribute, a
+    subscript store through one (``self._ranks[t] = r``), or a
+    membership-transition method call (any receiver — locals like
+    ``m = self._member`` must not hide one)."""
+    out = []
+
+    def _attr_store(target):
+        if isinstance(target, ast.Attribute) and target.attr in R003_STATE:
+            return target.attr
+        if isinstance(target, ast.Subscript) and \
+                isinstance(target.value, ast.Attribute) and \
+                target.value.attr in R003_STATE:
+            return target.value.attr
+        return None
+
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                name = _attr_store(t)
+                if name:
+                    out.append((node.lineno, f"store to {name}"))
+        elif isinstance(node, ast.AugAssign):
+            name = _attr_store(node.target)
+            if name:
+                out.append((node.lineno, f"store to {name}"))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _R003_MEMBER_MUTATORS:
+            out.append((node.lineno, f"membership .{node.func.attr}()"))
+    return out
+
+
+def _r003_issues(rel, tree):
+    if rel != R003_FILE:
+        return []
+    issues = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name == "__init__" or \
+                node.name.startswith(_R003_EXEMPT_PREFIXES):
+            continue
+        muts = _r003_mutations(node)
+        if muts and not _calls_any(node, {"_wal"}):
+            line, what = muts[0]
+            issues.append((
+                rel, line, "R003",
+                f"'{node.name}' mutates journaled tracker state "
+                f"({what}) without a self._wal(...) call — a resumed "
+                "tracker would forget it (see tracker/wal.py)"))
+    return issues
+
+
 def _calls_any(fn_node, call_names) -> bool:
     for node in ast.walk(fn_node):
         if not isinstance(node, ast.Call):
@@ -365,6 +438,7 @@ def check_file(path: str):
                                f"'{shown}' imported but unused"))
     issues.extend(_r001_issues(rel, tree, src))
     issues.extend(_r002_issues(rel, tree))
+    issues.extend(_r003_issues(rel, tree))
     issues.extend(_t003_issues(rel, tree))
     required = SPAN_REQUIRED.get(rel)
     if required:
