@@ -1,0 +1,124 @@
+"""Benchmark worker: bucketed gradient-sync rounds, sequential vs
+overlapped (tools/overlap_bench.py drives 4 of these over gloo).
+
+One "step" is ``N_BUCKETS`` buckets, each a backward-compute slice (a
+deterministic numpy matmul chain standing in for the next bucket's
+autodiff work) followed by that bucket's gradient allreduce. The sync
+series runs them DDP-naive: compute bucket b, then block inside
+``rabit.allreduce`` before touching bucket b+1 — the wire time is fully
+exposed. The overlap series issues ``rabit.allreduce_async`` instead
+and only waits once every bucket is in flight, so bucket b's wire time
+hides behind bucket b+1's compute (the paper's motivating overlap).
+Both series run on the same fabric and the same per-bucket inputs; the
+reduced buffers must be BIT-IDENTICAL across the two series (same ring,
+same schedule, only the host-side blocking moves). Per-step cost is the
+fleet MAX of per-rank wall time (a step completes when the slowest view
+does); rank 0 prints ONE JSON line with the two means (warmup excluded).
+
+argv: <process_id> <num_processes> <coordinator_port>
+env: N_BUCKETS (4), BUCKET_ELEMS (1000000 float32 per bucket),
+     COMPUTE_DIM (384), COMPUTE_REPS (8), N_ROUNDS (5), N_WARMUP (2)
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+
+def _make_buckets(rank: int, nb: int, elems: int):
+    """Per-rank deterministic bucket payloads (rank-varying so the
+    reduction is a real cross-rank merge, values bounded so float32
+    sums stay exact enough to compare bit-for-bit)."""
+    return [((np.arange(elems) % 251).astype(np.float32) + rank + b)
+            for b in range(nb)]
+
+
+def _run_step(bufs, compute, overlapped: bool):
+    """One bucketed step; returns (wall_s, [reduced buffers])."""
+    t0 = time.perf_counter()
+    if overlapped:
+        handles = []
+        for b, buf in enumerate(bufs):
+            compute(b)
+            handles.append(rabit.allreduce_async(buf, rabit.SUM))
+        outs = [h.wait() for h in handles]
+    else:
+        outs = []
+        for b, buf in enumerate(bufs):
+            compute(b)
+            outs.append(rabit.allreduce(buf, rabit.SUM))
+    return time.perf_counter() - t0, outs
+
+
+def _timed_series(rank: int, nb: int, elems: int, compute,
+                  overlapped: bool, rounds: int, warmup: int):
+    times = []
+    outs = None
+    for i in range(warmup + rounds):
+        rabit.allreduce(np.zeros(1, np.int32), rabit.SUM)  # align start
+        bufs = _make_buckets(rank, nb, elems)
+        dt, outs = _run_step(bufs, compute, overlapped)
+        if i >= warmup:
+            times.append(float(rabit.allreduce(
+                np.array([dt], np.float64), rabit.MAX)[0]))
+    return sum(times) / len(times), outs
+
+
+def main() -> None:
+    pid, nproc, port = sys.argv[1], sys.argv[2], sys.argv[3]
+    rabit.init(["rabit_engine=xla",
+                f"rabit_coordinator=127.0.0.1:{port}",
+                f"rabit_num_processes={nproc}",
+                f"rabit_process_id={pid}"])
+    rank, world = rabit.get_rank(), rabit.get_world_size()
+
+    nb = int(os.environ.get("N_BUCKETS", "4"))
+    elems = int(os.environ.get("BUCKET_ELEMS", "1000000"))
+    dim = int(os.environ.get("COMPUTE_DIM", "384"))
+    reps = int(os.environ.get("COMPUTE_REPS", "8"))
+    rounds = int(os.environ.get("N_ROUNDS", "5"))
+    warmup = int(os.environ.get("N_WARMUP", "2"))
+
+    a = np.full((dim, dim), 1.0 / dim, np.float32)
+
+    def compute(_b: int) -> None:
+        # stand-in for the next bucket's backward slice: numpy matmuls
+        # release the GIL, exactly like the jitted programs they model
+        acc = a
+        for _ in range(reps):
+            acc = acc @ a
+        assert np.isfinite(acc[0, 0])
+
+    sync_ms, sync_outs = _timed_series(rank, nb, elems, compute,
+                                       False, rounds, warmup)
+    overlap_ms, overlap_outs = _timed_series(rank, nb, elems, compute,
+                                             True, rounds, warmup)
+    for b, (s, o) in enumerate(zip(sync_outs, overlap_outs)):
+        assert np.array_equal(s, o), \
+            f"rank {rank} bucket {b}: overlap diverged from sync"
+
+    if rank == 0:
+        print(json.dumps({
+            "world": world, "n_buckets": nb, "bucket_elems": elems,
+            "dtype": "float32", "compute_dim": dim, "compute_reps": reps,
+            "rounds": rounds,
+            "bucket_step_ms_sync": round(sync_ms * 1e3, 3),
+            "bucket_step_ms_overlap": round(overlap_ms * 1e3, 3)},
+            ), flush=True)
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -208,6 +208,44 @@ def allreduce(data: np.ndarray, op: int,
     return buf.reshape(shape)
 
 
+def allreduce_async(data: np.ndarray, op: int,
+                    prepare_fun: Optional[Callable[[np.ndarray], None]]
+                    = None):
+    """Issue an allreduce without blocking; returns an awaitable handle
+    whose ``wait()`` yields the reduced array (input shape preserved).
+
+    The overlap primitive: issue a bucket's reduction, compute the next
+    bucket while the first rides the wire, then ``wait()`` in issue
+    order. Same validation and semantics as :func:`allreduce` —
+    including ``prepare_fun``, which runs at ISSUE time (the buffer is
+    snapshotted before this call returns, so the caller may overwrite
+    ``data`` immediately). Engines without a true async path complete
+    the op before returning (a correct, zero-overlap degenerate)."""
+    if not isinstance(data, np.ndarray):
+        raise TypeError("allreduce_async only takes numpy.ndarray")
+    if np.dtype(data.dtype) not in DTYPE_ENUM:
+        raise TypeError(f"dtype {data.dtype} not supported")
+    if op not in OP_NAMES:
+        raise ValueError(f"unknown op {op}")
+    if not is_valid_op_dtype(op, data.dtype):
+        raise TypeError(
+            f"op {OP_NAMES[op]} is not defined for dtype {data.dtype} "
+            "(reference rejects BitOR on floats, c_api.cc:26-35)")
+    from .engine.base import AllreduceHandle
+    eng = _require_engine()
+    shape = data.shape
+    buf = data.flatten()  # contiguous 1-D copy, never aliases data
+    if prepare_fun is None:
+        pf = None
+    else:
+        def pf(b=buf, d=data, f=prepare_fun):
+            f(d)
+            b[:] = np.ascontiguousarray(d).reshape(-1)
+    h = eng.allreduce_async(buf, op, prepare_fun=pf)
+    return AllreduceHandle(wait_fn=lambda: h.wait().reshape(shape),
+                           ready_fn=h.ready)
+
+
 def reduce_scatter(data: np.ndarray, op: int) -> np.ndarray:
     """Reduce ``data`` elementwise across ranks and return only this
     rank's chunk — a 1-D array of ``data.size / world_size`` elements

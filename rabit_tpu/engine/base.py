@@ -13,6 +13,43 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 
+class AllreduceHandle:
+    """Awaitable engine-level collective (:meth:`Engine.allreduce_async`).
+
+    ``wait()`` blocks until the buffer passed at issue holds the reduced
+    result, then returns it; idempotent. ``ready()`` is a non-blocking
+    completion probe (False when the engine can't tell). Engines without
+    a true async path complete the op at issue and hand back an
+    already-done handle — callers write one overlap-shaped loop and get
+    whatever overlap the engine can actually deliver."""
+
+    __slots__ = ("_wait_fn", "_ready_fn", "_value", "_done")
+
+    def __init__(self, wait_fn=None, value=None, ready_fn=None):
+        self._wait_fn = wait_fn
+        self._ready_fn = ready_fn
+        self._value = value
+        self._done = wait_fn is None
+
+    def ready(self) -> bool:
+        if self._done:
+            return True
+        if self._ready_fn is not None:
+            return bool(self._ready_fn())
+        return False
+
+    def wait(self):
+        if self._done:
+            return self._value
+        wait_fn, self._wait_fn = self._wait_fn, None
+        try:
+            self._value = wait_fn()
+        finally:
+            self._done = True
+            self._ready_fn = None
+        return self._value
+
+
 class Engine(ABC):
     """Collective engine. Buffers are 1-D contiguous numpy arrays mutated
     in place, matching the reference's in-place sendrecvbuf contract
@@ -39,6 +76,18 @@ class Engine(ABC):
         right before the reduction and is skipped when the result is
         replayed from the recovery cache. ``key`` is the caller-signature
         cache key used by the bootstrap cache (rabit.h:26-39)."""
+
+    def allreduce_async(self, buf: np.ndarray, op: int,
+                        prepare_fun: Optional[Callable[[], None]] = None,
+                        key: str = "") -> AllreduceHandle:
+        """Issue an in-place allreduce of ``buf`` and return an
+        awaitable :class:`AllreduceHandle`; ``buf`` must not be read or
+        written until ``wait()`` returns. Default implementation
+        completes the collective synchronously (zero overlap, same
+        result); the XLA engine overrides with a genuinely overlapped
+        dispatch behind ``rabit_async_collectives``."""
+        self.allreduce(buf, op, prepare_fun=prepare_fun, key=key)
+        return AllreduceHandle(value=buf)
 
     @abstractmethod
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:

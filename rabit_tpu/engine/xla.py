@@ -26,11 +26,12 @@ engine and wraps this one.
 
 from __future__ import annotations
 
+import time
 from typing import Callable, List, Optional, Tuple
 
 import numpy as np
 
-from .base import Engine
+from .base import AllreduceHandle, Engine
 from . import ckpt_store
 from .. import telemetry
 from ..telemetry import profile as _profile
@@ -67,6 +68,10 @@ class XlaEngine(Engine):
         # live observability plane (off by default, see engine/native.py)
         self._metrics_server = None
         self._flight = None
+        # async collective dispatch (ISSUE 11): lazily-built 1-worker
+        # executor + in-flight futures; see _async_executor for why ONE
+        self._async_ex = None
+        self._async_pending: list = []
 
     def init(self, args: List[str]) -> None:
         import jax
@@ -130,6 +135,8 @@ class XlaEngine(Engine):
         log.set_identity(self._rank, self._world)
         telemetry.configure(cfg)
         _profile.configure(cfg)
+        from ..parallel.collectives import configure_async
+        configure_async(cfg)
         self._watchdog = Watchdog.from_config(cfg)
         self._start_live_plane(cfg)
         if self._world > 1:
@@ -234,6 +241,12 @@ class XlaEngine(Engine):
         _fl.note("member_resize", f"world {old} -> {world}")
 
     def shutdown(self) -> None:
+        try:
+            self._drain_async()
+        finally:
+            if self._async_ex is not None:
+                self._async_ex.shutdown(wait=True)
+                self._async_ex = None
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None
@@ -244,19 +257,7 @@ class XlaEngine(Engine):
         telemetry.export_at_shutdown(self._rank, self._world)
 
     # -- collectives ------------------------------------------------------
-    def allreduce(self, buf: np.ndarray, op: int,
-                  prepare_fun: Optional[Callable[[], None]] = None,
-                  key: str = "") -> None:
-        if prepare_fun is not None:
-            prepare_fun()
-        if self._world == 1:
-            return
-        import contextlib
-        import jax
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        from ..ops.reducers import OP_NAMES
-        from ..parallel.collectives import device_allreduce
-        n = buf.size
+    def _resolve_method_wire(self, n: int):
         method = self._method
         if method == "auto" and self._ring_mincount is not None:
             method = "ring" if n >= self._ring_mincount else "tree"
@@ -265,12 +266,20 @@ class XlaEngine(Engine):
         # unquantized — wire loses wall-clock there AND costs accuracy
         wire = self._wire if (self._wire and n >= self._wire_mincount) \
             else None
+        return method, wire
+
+    def _allreduce_device(self, buf: np.ndarray, op: int, method: str,
+                          wire: Optional[str], sp=None) -> None:
+        """The device half of :meth:`allreduce`: stage, reduce, fetch,
+        copy back in place. Shared verbatim by the sync path (under its
+        span + watchdog) and the async worker (whose span is recorded
+        at ``wait()`` with the exposed/overlapped split)."""
+        import contextlib
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.collectives import device_allreduce
+        n = buf.size
         mesh = self._mesh
-        sp = telemetry.span("engine.allreduce", nbytes=buf.nbytes,
-                            op=OP_NAMES.get(op, str(op)), method=method,
-                            wire=wire,
-                            round=telemetry.collective_round(
-                                "engine.allreduce"))
         # 64-bit payloads: without x64, device_put silently truncates
         # int64/float64 to 32 bits; scope-enable it for this reduction
         # (jax.enable_x64 is the >=0.9 spelling; older jax has the same
@@ -280,8 +289,7 @@ class XlaEngine(Engine):
                    else _experimental_enable_x64())
         else:
             ctx = contextlib.nullcontext()
-        wd = self._watchdog.guard("engine.allreduce", nbytes=buf.nbytes)
-        with wd, sp, ctx:
+        with ctx:
             sharding = NamedSharding(mesh, P("proc"))
             local = jax.device_put(buf.reshape(1, n), mesh.local_devices[0])
             xs = jax.make_array_from_single_device_arrays(
@@ -297,7 +305,7 @@ class XlaEngine(Engine):
             else:
                 out = device_allreduce(xs, mesh, op, axis="proc",
                                        method=method, wire=wire)
-            if sp.live:
+            if sp is not None and sp.live:
                 # round-carrying span learns which adaptation the device
                 # layer applied (if any) so cross-rank stitching can
                 # label adapted rounds (telemetry/skew.py)
@@ -310,7 +318,117 @@ class XlaEngine(Engine):
             raise TypeError(
                 f"device allreduce changed dtype {buf.dtype} -> {res.dtype}")
         np.copyto(buf, res)
+
+    def allreduce(self, buf: np.ndarray, op: int,
+                  prepare_fun: Optional[Callable[[], None]] = None,
+                  key: str = "") -> None:
+        if prepare_fun is not None:
+            prepare_fun()
+        if self._world == 1:
+            return
+        self._drain_async()
+        from ..ops.reducers import OP_NAMES
+        n = buf.size
+        method, wire = self._resolve_method_wire(n)
+        sp = telemetry.span("engine.allreduce", nbytes=buf.nbytes,
+                            op=OP_NAMES.get(op, str(op)), method=method,
+                            wire=wire,
+                            round=telemetry.collective_round(
+                                "engine.allreduce"))
+        wd = self._watchdog.guard("engine.allreduce", nbytes=buf.nbytes)
+        with wd, sp:
+            self._allreduce_device(buf, op, method, wire, sp=sp)
         log_debug("xla allreduce n=%d op=%d method=%s", n, op, method)
+
+    def allreduce_async(self, buf: np.ndarray, op: int,
+                        prepare_fun: Optional[Callable[[], None]] = None,
+                        key: str = "") -> AllreduceHandle:
+        """Issue the allreduce on the dispatch thread and return an
+        awaitable handle; the caller's thread is free to compute the
+        next bucket while this one rides the wire. The watchdog guard
+        arms NOW and disarms when the op completes (or fails), so every
+        in-flight op keeps its deadline. ``buf`` must be left alone
+        until ``wait()`` returns it."""
+        if prepare_fun is not None:
+            prepare_fun()
+        if self._world == 1:
+            return AllreduceHandle(value=buf)
+        from ..ops.reducers import OP_NAMES
+        n = buf.size
+        method, wire = self._resolve_method_wire(n)
+        opname = OP_NAMES.get(op, str(op))
+        nbytes = buf.nbytes
+        rnd = telemetry.collective_round("engine.allreduce")
+        telemetry.count("async.issued", nbytes=nbytes, op=opname,
+                        method=method, wire=wire, provenance="engine")
+        guard = self._watchdog.guard("engine.allreduce", nbytes=nbytes)
+        guard.__enter__()
+        t_issue = time.perf_counter()
+
+        def task():
+            try:
+                self._allreduce_device(buf, op, method, wire)
+            finally:
+                guard.__exit__(None, None, None)
+
+        with telemetry.span("engine.allreduce.issue", nbytes=nbytes,
+                            op=opname, method=method, wire=wire,
+                            round=rnd):
+            fut = self._async_executor().submit(task)
+        self._async_pending.append(fut)
+
+        def wait_fn():
+            t_wait = time.perf_counter()
+            try:
+                fut.result()
+            finally:
+                try:
+                    self._async_pending.remove(fut)
+                except ValueError:
+                    pass
+            t_done = time.perf_counter()
+            exposed = t_done - t_wait
+            overlapped = max(0.0, (t_done - t_issue) - exposed)
+            telemetry.record_span(
+                "engine.allreduce", t_done - t_issue, nbytes=nbytes,
+                op=opname, method=method, wire=wire, provenance="engine",
+                **{"round": rnd, "async": 1,
+                   "wire_exposed_ms": exposed * 1e3,
+                   "wire_overlapped_ms": overlapped * 1e3})
+            _profile.record_overlap("engine.allreduce", method, exposed,
+                                    overlapped)
+            log_debug("xla async allreduce n=%d op=%d method=%s",
+                      n, op, method)
+            return buf
+
+        return AllreduceHandle(wait_fn=wait_fn, ready_fn=fut.done)
+
+    def _async_executor(self):
+        """ONE worker on purpose: a FIFO queue makes async issue order
+        == device collective order in every process, so uniformly
+        programmed ranks keep tracing one global schedule — concurrent
+        workers could reorder collectives differently per rank and
+        deadlock the fabric."""
+        if self._async_ex is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._async_ex = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="rabit-async")
+        return self._async_ex
+
+    def _drain_async(self) -> None:
+        """Fence before any synchronous collective: every process must
+        observe one global collective order, so sync ops wait out the
+        async queue first. Failures propagate here (fail fast) and
+        again from the failed handle's own ``wait()``."""
+        while self._async_pending:
+            fut = self._async_pending[0]
+            try:
+                fut.result()
+            finally:
+                try:
+                    self._async_pending.remove(fut)
+                except ValueError:
+                    pass
 
     def reduce_scatter(self, buf: np.ndarray, op: int) -> np.ndarray:
         """True ring reduce-scatter on the device mesh: ships 1/p of
@@ -318,6 +436,7 @@ class XlaEngine(Engine):
         documents the ownership layout)."""
         if self._world == 1:
             return buf.copy()
+        self._drain_async()
         if buf.size % self._world:
             raise ValueError(
                 f"reduce_scatter payload of {buf.size} elements must "
@@ -340,6 +459,7 @@ class XlaEngine(Engine):
         arithmetic, p-1 neighbor hops)."""
         if self._world == 1:
             return buf.reshape(-1).copy()
+        self._drain_async()
         from ..parallel.collectives import device_allgather
         nbytes = buf.nbytes * self._world
         with telemetry.span("engine.allgather", nbytes=nbytes,

@@ -37,7 +37,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..ops.reducers import SUM
 from ..parallel.collectives import (
     ring_allreduce, bucket_allreduce, shard_map, unchecked_shard_map,
-    psum_identity_grad, ident_psum_grad)
+    psum_identity_grad, ident_psum_grad, async_enabled,
+    grad_bucket_allreduce_async)
 from ..parallel.ring_attention import ring_attention, reference_attention
 
 Params = Dict[str, jax.Array]
@@ -210,6 +211,12 @@ def make_train_step(mesh: Mesh, lr: float = 0.1, grad_sync: str = "psum"):
     if grad_sync not in ("psum", "ring", "bucket"):
         raise ValueError(f"grad_sync must be 'psum', 'ring' or 'bucket', "
                          f"got {grad_sync!r}")
+    if grad_sync == "bucket" and async_enabled():
+        # overlapped pipeline (rabit_async_collectives=1): see the MLP
+        # twin — grads program (sp partials folded) -> per-bucket async
+        # dp-allreduce issues in reverse order -> update program chained
+        # on the raw futures
+        return _make_async_bucket_step(mesh, lr)
     dp_axis, tp_axis, sp_axis = mesh.axis_names
     checked = grad_sync == "psum"
 
@@ -249,6 +256,84 @@ def make_train_step(mesh: Mesh, lr: float = 0.1, grad_sync: str = "psum"):
                in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
                out_specs=(specs, P()))
         return f(params, tokens, targets)
+
+    return step
+
+
+def _make_async_bucket_step(mesh: Mesh, lr: float):
+    """Overlapped bucketed train step for the (dp, tp, sp) mesh — the
+    transformer twin of ``models.mlp._make_async_bucket_step``: a
+    jitted grads program folds the sp partials and emits per-dtype flat
+    gradient buckets ([dp, tp, n] layout, tp rows distinct), each
+    bucket's dp-allreduce issues asynchronously in reverse bucket
+    order, and a jitted update program consumes the raw futures.
+    Numerics match ``grad_sync="bucket"`` (same presum, same concat
+    order, same ring)."""
+    dp_axis, tp_axis, sp_axis = mesh.axis_names
+    cache: Dict[tuple, tuple] = {}
+
+    def build(params: Params):
+        keys = sorted(params)
+        specs = param_specs(params)
+        buckets: Dict = {}
+        for i, k in enumerate(keys):
+            buckets.setdefault(jnp.dtype(params[k].dtype), []).append(i)
+        plan = tuple(tuple(idxs) for idxs in buckets.values())
+        nb = len(plan)
+
+        def grads_per_shard(p: Params, tokens, targets):
+            partial, grads = jax.value_and_grad(_local_loss)(
+                p, tokens, targets, sp_axis, tp_axis, dp_axis, False)
+            loss = lax.psum(partial, (dp_axis, sp_axis))
+            # fold sp partials first (the sync path's presum_axis), so
+            # the bucket rows really are sp-replicated
+            gl = [lax.psum(grads[k], sp_axis) for k in keys]
+            flats = tuple(
+                jnp.concatenate([gl[i].reshape(-1) for i in idxs])
+                [None, None, :] for idxs in plan)
+            return (loss,) + flats
+
+        grads_fn = jax.jit(unchecked_shard_map(
+            grads_per_shard, mesh=mesh,
+            in_specs=(specs, P(dp_axis, sp_axis), P(dp_axis, sp_axis)),
+            out_specs=(P(),) + (P(dp_axis, tp_axis, None),) * nb))
+
+        def update_per_shard(p: Params, *red_flats):
+            new_p = dict(p)
+            for idxs, flat in zip(plan, red_flats):
+                flat = flat.reshape(-1)
+                off = 0
+                for i in idxs:
+                    k = keys[i]
+                    w = p[k]
+                    g = flat[off:off + w.size].reshape(w.shape)
+                    new_p[k] = (w - lr * g).astype(w.dtype)
+                    off += w.size
+            return new_p
+
+        update_fn = jax.jit(unchecked_shard_map(
+            update_per_shard, mesh=mesh,
+            in_specs=(specs,) + (P(tp_axis, None),) * nb,
+            out_specs=specs))
+        return grads_fn, update_fn, nb
+
+    def step(params: Params, tokens, targets):
+        key = tuple(
+            (k, tuple(params[k].shape), jnp.dtype(params[k].dtype).name)
+            for k in sorted(params))
+        if key not in cache:
+            cache[key] = build(params)
+        grads_fn, update_fn, nb = cache[key]
+        outs = grads_fn(params, tokens, targets)
+        loss, flats = outs[0], outs[1:]
+        handles = [None] * nb
+        for j in reversed(range(nb)):
+            handles[j] = grad_bucket_allreduce_async(
+                flats[j], mesh, dp_axis, tp_axis, SUM, method="ring")
+        new_p = update_fn(params, *[h.value for h in handles])
+        for h in handles:
+            h.wait()
+        return new_p, loss
 
     return step
 

@@ -52,6 +52,11 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
+import threading
+import time
+import warnings
+import weakref
 from typing import Optional
 
 import jax
@@ -943,6 +948,19 @@ def _rotation_for(mesh: Mesh, axis: str, world: int):
     return _skew.rotation_order(world, lag), adapted
 
 
+def _stamp_exposed(sp, t0: float) -> None:
+    """Split a live span's wall time into exposed vs overlapped wire
+    attrs. A synchronous collective blocks the host for its whole
+    duration, so everything from dispatch to block_until_ready is
+    exposed and nothing is overlapped; the async handles stamp the
+    measured split instead. Cross-rank critical-path stitching
+    (telemetry/crossrank.py) prefers these attrs over the raw span
+    duration, so the tables stay honest when sync and async rounds
+    mix."""
+    sp.attrs["wire_exposed_ms"] = (time.perf_counter() - t0) * 1e3
+    sp.attrs["wire_overlapped_ms"] = 0.0
+
+
 def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
                      axis: Optional[str] = None,
                      method: str = "auto",
@@ -1020,6 +1038,7 @@ def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
                         op=OP_NAMES.get(op, str(op)), method=method,
                         wire=wire, **extra)
     with sp:
+        t0 = time.perf_counter()
         with _profile.jit_probe("allreduce", _allreduce_global):
             out = _allreduce_global(xs, mesh, axis, op, method, wire,
                                     groups)
@@ -1027,6 +1046,7 @@ def device_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
             # only when measuring: a span closed on dispatch would time
             # the async enqueue, not the collective
             out.block_until_ready()
+            _stamp_exposed(sp, t0)
     return out
 
 
@@ -1093,10 +1113,12 @@ def device_reduce_scatter(xs: jax.Array, mesh: Mesh, op: int = SUM,
                         op=OP_NAMES.get(op, str(op)), method="ring",
                         wire=wire, **extra)
     with sp:
+        t0 = time.perf_counter()
         with _profile.jit_probe("reduce_scatter", _reduce_scatter_global):
             out = _reduce_scatter_global(xs, mesh, axis, op, wire, order)
         if sp.live:
             out.block_until_ready()
+            _stamp_exposed(sp, t0)
     return out
 
 
@@ -1148,10 +1170,12 @@ def device_allgather(xs: jax.Array, mesh: Mesh,
     sp = telemetry.span("allgather", nbytes=n * xs.dtype.itemsize,
                         method="ring", **extra)
     with sp:
+        t0 = time.perf_counter()
         with _profile.jit_probe("allgather", _allgather_global):
             out = _allgather_global(xs, mesh, axis, order)
         if sp.live:
             out.block_until_ready()
+            _stamp_exposed(sp, t0)
     return out
 
 
@@ -1263,10 +1287,12 @@ def device_hier_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
                             group_size=g, **extra)
         with guard(name, nbytes):
             with sp:
+                t0 = time.perf_counter()
                 with _profile.jit_probe(name, fn):
                     out = fn(*args)
                 if sp.live:
                     out.block_until_ready()
+                    _stamp_exposed(sp, t0)
         return out
 
     mid = _phase("hier.reduce_scatter", "reduce_scatter",
@@ -1389,11 +1415,13 @@ def device_allreduce_tree(tree, mesh: Mesh, op: int = SUM,
         method=",".join(sorted({m for _, m, _ in spec})),
         buckets=len(spec), leaves=len(leaves))
     with sp:
+        t0 = time.perf_counter()
         with _profile.jit_probe("allreduce_tree", _allreduce_tree_global):
             out = _allreduce_tree_global(tuple(leaves), treedef, mesh,
                                          axis, op, spec)
         if sp.live:
             jax.block_until_ready(out)
+            _stamp_exposed(sp, t0)
     return out
 
 
@@ -1418,10 +1446,12 @@ def device_broadcast(xs: jax.Array, mesh: Mesh, root: int = 0,
     sp = telemetry.span("broadcast", nbytes=n * xs.dtype.itemsize,
                         method="psum_mask", root=root)
     with sp:
+        t0 = time.perf_counter()
         with _profile.jit_probe("broadcast", _broadcast_global):
             out = _broadcast_global(xs, mesh, axis, root)
         if sp.live:
             out.block_until_ready()
+            _stamp_exposed(sp, t0)
     return out
 
 
@@ -1433,3 +1463,507 @@ def shard_over(mesh: Mesh, xs: np.ndarray, axis: Optional[str] = None):
         axis = mesh.axis_names[0]
     sharding = NamedSharding(mesh, P(axis))
     return jax.device_put(xs, sharding)
+
+
+# ---------------------------------------------------------------------------
+# Async collectives: issue -> overlap -> wait (ROADMAP open item 3).
+#
+# jax arrays are futures — dispatching a jitted collective returns
+# immediately and the wire work proceeds while the host (or the next
+# device program, via data dependence) keeps computing. These entry
+# points expose that as an explicit handle so callers can pipeline:
+# bucket i's allreduce rides the wire while bucket i+1's backward is
+# still computing. Off by default (``rabit_async_collectives``); the
+# sync entry points above are byte-for-byte untouched when unset.
+# ---------------------------------------------------------------------------
+
+_ASYNC_ENV = "RABIT_ASYNC_COLLECTIVES"
+_ASYNC_INFLIGHT_ENV = "RABIT_ASYNC_MAX_INFLIGHT"
+ASYNC_MAX_INFLIGHT_DEFAULT = 4
+
+
+def async_enabled() -> bool:
+    """Master knob for the async collective pipelines (models, engine).
+    The ``*_async`` entry points themselves work regardless — this
+    gates the places that would silently change an existing sync
+    code path's schedule."""
+    return os.environ.get(_ASYNC_ENV, "").lower() in ("1", "true", "yes",
+                                                      "on")
+
+
+def async_max_inflight() -> int:
+    """Cap on concurrently in-flight async collectives. Admitting one
+    past the cap blocks on the OLDEST handle first — bounded device
+    memory for staged payloads, and a natural back-pressure that keeps
+    issue order == completion order."""
+    try:
+        return max(1, int(os.environ.get(_ASYNC_INFLIGHT_ENV,
+                                         ASYNC_MAX_INFLIGHT_DEFAULT)))
+    except ValueError:
+        return ASYNC_MAX_INFLIGHT_DEFAULT
+
+
+def configure_async(cfg: dict) -> None:
+    """Export the async knobs from an engine config dict to the env,
+    so model code (which never sees the config) reads one source of
+    truth. Called by engine init; host env settings win only when the
+    config is silent."""
+    v = cfg.get("rabit_async_collectives")
+    if v is not None:
+        os.environ[_ASYNC_ENV] = str(v)
+    v = cfg.get("rabit_async_max_inflight")
+    if v is not None:
+        os.environ[_ASYNC_INFLIGHT_ENV] = str(v)
+
+
+_INFLIGHT_LOCK = threading.Lock()
+# weakrefs: the window must never keep a dropped handle alive — its
+# __del__ IS the drop-detection path (warn + counter + guard disarm)
+_INFLIGHT: list = []
+
+
+def _admit(handle) -> None:
+    # Never wait while holding the lock: wait() retires, which locks.
+    while True:
+        with _INFLIGHT_LOCK:
+            _INFLIGHT[:] = [r for r in _INFLIGHT if r() is not None]
+            if len(_INFLIGHT) < async_max_inflight():
+                _INFLIGHT.append(weakref.ref(handle))
+                return
+            oldest = _INFLIGHT[0]()
+        if oldest is None:
+            continue  # died between prune and deref; re-prune
+        oldest.wait()
+
+
+def _retire(handle) -> None:
+    with _INFLIGHT_LOCK:
+        _INFLIGHT[:] = [r for r in _INFLIGHT
+                        if r() is not None and r() is not handle]
+
+
+def inflight_count() -> int:
+    with _INFLIGHT_LOCK:
+        _INFLIGHT[:] = [r for r in _INFLIGHT if r() is not None]
+        return len(_INFLIGHT)
+
+
+class AsyncHandle:
+    """Awaitable result of an asynchronously issued device collective.
+
+    Lifecycle: the issuing entry point dispatches the jitted program
+    (non-blocking — the output array is a future), stamps an
+    ``<name>.issue`` span on the dispatch itself, arms the caller's
+    watchdog guard if given, and admits the handle to the bounded
+    in-flight registry. ``wait()`` blocks until the result is ready,
+    disarms the guard, retires the handle, and records the REAL span —
+    total issue→ready wall time split into ``wire_exposed_ms`` (time
+    the caller actually blocked inside wait) and ``wire_overlapped_ms``
+    (wire time hidden behind whatever the caller did in between) —
+    feeding the profiling plane's overlap accounting.
+
+    ``value`` is the raw device future: feed it straight into the next
+    jitted program for block-free chaining (jax sequences the data
+    dependency on-device; no host sync). ``wait()`` is idempotent.
+    Dropping a handle without waiting warns and counts
+    ``async.dropped_handle`` — the op still completes, but its wire
+    time was never accounted and its guard would otherwise leak."""
+
+    def __init__(self, out, *, name: str, nbytes: int, attrs: dict,
+                 guard=None, postprocess=None):
+        self._out = out
+        self._name = name
+        self._nbytes = int(nbytes)
+        self._attrs = dict(attrs)
+        self._guard = guard
+        if guard is not None:
+            guard.__enter__()
+        self._post = postprocess
+        self._done = False
+        self._result = None
+        self._t_issue = time.perf_counter()
+        _admit(self)
+
+    @property
+    def value(self):
+        """The raw device future (pre-postprocess) — for chaining into
+        the next device program without a host sync."""
+        return self._out
+
+    def ready(self) -> bool:
+        if self._done:
+            return True
+        is_ready = getattr(self._out, "is_ready", None)
+        if is_ready is None:
+            # pytree or older jaxlib: no cheap readiness probe
+            leaves = jax.tree_util.tree_leaves(self._out)
+            return all(getattr(leaf, "is_ready", lambda: False)()
+                       for leaf in leaves)
+        return bool(is_ready())
+
+    def wait(self):
+        if self._done:
+            return self._result
+        t_wait = time.perf_counter()
+        try:
+            jax.block_until_ready(self._out)
+        finally:
+            self._done = True
+            if self._guard is not None:
+                self._guard.__exit__(None, None, None)
+                self._guard = None
+            _retire(self)
+        t_done = time.perf_counter()
+        total = t_done - self._t_issue
+        exposed = t_done - t_wait
+        overlapped = max(0.0, total - exposed)
+        attrs = dict(self._attrs)
+        attrs["wire_exposed_ms"] = exposed * 1e3
+        attrs["wire_overlapped_ms"] = overlapped * 1e3
+        telemetry.record_span(self._name, total, nbytes=self._nbytes,
+                              **attrs)
+        _profile.record_overlap(self._name, self._attrs.get("method"),
+                                exposed, overlapped)
+        post, self._post = self._post, None
+        self._result = post(self._out) if post else self._out
+        return self._result
+
+    def __del__(self):
+        try:
+            if not self._done:
+                self._done = True
+                warnings.warn(
+                    f"async collective handle '{self._name}' dropped "
+                    "without wait(); result discarded and wire time "
+                    "unaccounted", RuntimeWarning, stacklevel=2)
+                telemetry.count("async.dropped_handle")
+                if self._guard is not None:
+                    self._guard.__exit__(None, None, None)
+                _retire(self)
+        except Exception:
+            pass  # interpreter teardown: modules may be half-gone
+
+
+class AsyncTreeHandle:
+    """Composite handle over a sequence of per-bucket
+    :class:`AsyncHandle`\\ s (``bucket_allreduce_async``). ``wait()``
+    awaits every bucket (oldest first — completion order matches issue
+    order on a FIFO fabric) and assembles the final pytree once."""
+
+    def __init__(self, handles, assemble):
+        self._handles = list(handles)
+        self._assemble = assemble
+        self._done = False
+        self._result = None
+
+    @property
+    def handles(self):
+        return tuple(self._handles)
+
+    def ready(self) -> bool:
+        return self._done or all(h.ready() for h in self._handles)
+
+    def wait(self):
+        if self._done:
+            return self._result
+        parts = [h.wait() for h in self._handles]
+        assemble, self._assemble = self._assemble, None
+        self._result = assemble(parts)
+        self._done = True
+        return self._result
+
+
+def device_allreduce_async(xs: jax.Array, mesh: Mesh, op: int = SUM,
+                           axis: Optional[str] = None,
+                           method: str = "auto",
+                           wire: Optional[str] = "auto",
+                           groups=None, guard=None) -> AsyncHandle:
+    """:func:`device_allreduce`, split into issue and await. Same
+    dispatch-table resolution, skew agreement boundary (consumed at
+    ISSUE time — the schedule is fixed when the program is traced, not
+    when the caller waits), cost stamping, and provenance; the span is
+    recorded at ``wait()`` with the exposed/overlapped split.
+
+    ``guard`` is an UNENTERED watchdog guard (``Watchdog.guard(...)``)
+    covering issue→completion; the handle arms it now and disarms it in
+    ``wait()`` (or on drop), so in-flight ops keep their deadline."""
+    if axis is None:
+        axis = mesh.axis_names[0]
+    n = int(np.prod(xs.shape[1:]))
+    if _skew.adapt_enabled():
+        _skew_sync_point(mesh, axis)
+    groups = _topology.resolve_groups(mesh.shape[axis], explicit=groups)
+    method, wire = _dispatch_resolve(n, xs.dtype, op, mesh.shape[axis],
+                                     method=method, wire=wire,
+                                     groups=groups)
+    if method not in ("hier", "preagg"):
+        groups = None
+    adapted = None
+    if _skew.adapt_enabled():
+        plan = _skew.adapt_plan(method, mesh.shape[axis],
+                                n * xs.dtype.itemsize,
+                                OP_NAMES.get(op, str(op)), groups=groups,
+                                digest=_skew.monitor().applied())
+        if plan is not None:
+            method, groups = plan["method"], plan["groups"]
+            if method == "preagg":
+                wire = None
+            adapted = f"{plan['kind']}@{plan['laggard']}"
+        _skew.note_applied(adapted)
+    cost = _profile.record_cost(
+        "allreduce", method, wire, n, xs.dtype.itemsize, mesh.shape[axis],
+        group_size=len(groups[0]) if groups else None)
+    extra = ({"cost_flops": cost["flops"],
+              "cost_wire_bytes": cost["wire_bytes"],
+              "cost_hops": cost["hops"]} if cost else {})
+    if method == "hier" and groups:
+        extra["hosts"] = len(groups)
+    if adapted:
+        extra["adapted"] = adapted
+    nbytes = n * xs.dtype.itemsize
+    opname = OP_NAMES.get(op, str(op))
+    rnd = telemetry.collective_round("allreduce")
+    telemetry.count("async.issued", nbytes=nbytes, op=opname,
+                    method=method, wire=wire)
+    with telemetry.span("allreduce.issue", nbytes=nbytes, op=opname,
+                        method=method, wire=wire, round=rnd, **extra):
+        with _profile.jit_probe("allreduce", _allreduce_global):
+            out = _allreduce_global(xs, mesh, axis, op, method, wire,
+                                    groups)
+    attrs = {"op": opname, "method": method, "wire": wire, "round": rnd,
+             "async": 1}
+    attrs.update(extra)
+    return AsyncHandle(out, name="allreduce", nbytes=nbytes, attrs=attrs,
+                       guard=guard)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "dp_axis", "tp_axis",
+                                             "op", "method", "wire"))
+def _grad_bucket_allreduce_global(xs, mesh: Mesh, dp_axis: str,
+                                  tp_axis: str, op: int, method: str,
+                                  wire: str | None):
+    # xs: [dp, tp, n] — one flat gradient bucket per (dp, tp) shard;
+    # reduce over dp only, each tp shard keeps its own row (model-
+    # parallel grads differ per tp shard by construction)
+    def per_shard(x):
+        flat = x.reshape(-1)  # [1, 1, n] -> [n]
+        return _per_shard_allreduce(flat, dp_axis, op, method,
+                                    wire)[None, :]
+    return unchecked_shard_map(
+        per_shard, mesh=mesh, in_specs=P(dp_axis, tp_axis, None),
+        out_specs=P(tp_axis, None))(xs)
+
+
+def grad_bucket_allreduce_async(xs: jax.Array, mesh: Mesh, dp_axis: str,
+                                tp_axis: str, op: int = SUM,
+                                method: str = "ring",
+                                wire: Optional[str] = None,
+                                guard=None) -> AsyncHandle:
+    """Issue one gradient bucket's data-parallel allreduce without
+    blocking — the model pipelines' workhorse. ``xs`` is [dp, tp, n]
+    (flat bucket per shard, tp rows distinct); the result is [tp, n],
+    reduced over ``dp_axis``. The returned handle's ``value`` feeds the
+    parameter-update program directly: consecutive buckets' wire time
+    overlaps on-device while the host never syncs."""
+    n = int(xs.shape[-1])
+    if _skew.adapt_enabled():
+        _skew_sync_point(mesh, dp_axis)
+    wire = None if wire in (None, "none", "auto") else wire
+    wire = _normalize_wire(wire, op, xs.dtype)
+    cost = _profile.record_cost("bucket_allreduce", method, wire, n,
+                                xs.dtype.itemsize, mesh.shape[dp_axis])
+    extra = ({"cost_flops": cost["flops"],
+              "cost_wire_bytes": cost["wire_bytes"],
+              "cost_hops": cost["hops"]} if cost else {})
+    nbytes = n * xs.dtype.itemsize
+    opname = OP_NAMES.get(op, str(op))
+    rnd = telemetry.collective_round("bucket_allreduce")
+    telemetry.count("async.issued", nbytes=nbytes, op=opname,
+                    method=method, wire=wire)
+    with telemetry.span("bucket_allreduce.issue", nbytes=nbytes, op=opname,
+                        method=method, wire=wire, round=rnd, **extra):
+        with _profile.jit_probe("bucket_allreduce",
+                                _grad_bucket_allreduce_global):
+            out = _grad_bucket_allreduce_global(xs, mesh, dp_axis, tp_axis,
+                                                op, method, wire)
+    attrs = {"op": opname, "method": method, "wire": wire, "round": rnd,
+             "async": 1}
+    attrs.update(extra)
+    return AsyncHandle(out, name="bucket_allreduce", nbytes=nbytes,
+                       attrs=attrs, guard=guard)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "op",
+                                             "method", "wire"))
+def _bucket_flat_global(leaves, mesh: Mesh, axis: str, op: int,
+                        method: str, wire: str | None):
+    def per_shard(shards):
+        flat = jnp.concatenate([x.reshape(-1) for x in shards])
+        return _per_shard_allreduce(flat, axis, op, method, wire)
+    return unchecked_shard_map(per_shard, mesh=mesh, in_specs=P(axis),
+                               out_specs=P())(tuple(leaves))
+
+
+def bucket_allreduce_async(tree, mesh: Mesh, op: int = SUM,
+                           axis: Optional[str] = None,
+                           method: str = "auto",
+                           wire: Optional[str] = "auto") -> AsyncTreeHandle:
+    """:func:`device_allreduce_tree`, issued bucket-by-bucket without
+    blocking. Leaves are [p, ...] (the :func:`device_allreduce` layout);
+    per-dtype buckets dispatch in REVERSED bucket order — under
+    reverse-mode autodiff the late layers' gradients materialize first,
+    so issuing their bucket first maximizes the wire time hidden behind
+    the remaining compute (DDP ready-order launch). Each bucket's
+    method/wire resolves from the dispatch table on the bucket's total
+    element count, as in the sync path. ``wait()`` returns the reduced
+    pytree (leaf shapes ``leaf.shape[1:]``, replicated)."""
+    if axis is None:
+        axis = mesh.axis_names[0]
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if not leaves:
+        return AsyncTreeHandle([], lambda parts: tree)
+    if _skew.adapt_enabled():
+        _skew_sync_point(mesh, axis)
+    buckets: dict = {}
+    for i, leaf in enumerate(leaves):
+        buckets.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    opname = OP_NAMES.get(op, str(op))
+    order = list(buckets.items())
+    handles = []
+    issued_idxs = []
+    for dt, idxs in reversed(order):
+        sizes = tuple(int(np.prod(leaves[i].shape[1:])) for i in idxs)
+        shapes = tuple(leaves[i].shape[1:] for i in idxs)
+        n = sum(sizes)
+        mth, w = _dispatch_resolve(n, dt, op, mesh.shape[axis],
+                                   method=method, wire=wire)
+        if mth in ("hier", "preagg"):
+            mth = "ring"  # bucket path dispatches flat schedules only
+        cost = _profile.record_cost("bucket_allreduce", mth, w, n,
+                                    dt.itemsize, mesh.shape[axis])
+        extra = ({"cost_flops": cost["flops"],
+                  "cost_wire_bytes": cost["wire_bytes"],
+                  "cost_hops": cost["hops"]} if cost else {})
+        nbytes = n * dt.itemsize
+        rnd = telemetry.collective_round("bucket_allreduce")
+        telemetry.count("async.issued", nbytes=nbytes, op=opname,
+                        method=mth, wire=w)
+        bucket_leaves = tuple(leaves[i] for i in idxs)
+        with telemetry.span("bucket_allreduce.issue", nbytes=nbytes,
+                            op=opname, method=mth, wire=w, round=rnd,
+                            buckets=1, leaves=len(idxs), **extra):
+            with _profile.jit_probe("bucket_allreduce",
+                                    _bucket_flat_global):
+                red = _bucket_flat_global(bucket_leaves, mesh, axis, op,
+                                          mth, w)
+
+        def _split(red, sizes=sizes, shapes=shapes):
+            out, off = [], 0
+            for size, shape in zip(sizes, shapes):
+                out.append(red[off:off + size].reshape(shape))
+                off += size
+            return out
+
+        attrs = {"op": opname, "method": mth, "wire": w, "round": rnd,
+                 "async": 1}
+        attrs.update(extra)
+        handles.append(AsyncHandle(red, name="bucket_allreduce",
+                                   nbytes=nbytes, attrs=attrs,
+                                   postprocess=_split))
+        issued_idxs.append(tuple(idxs))
+
+    def assemble(parts):
+        out = [None] * len(leaves)
+        for idxs, pieces in zip(issued_idxs, parts):
+            for i, piece in zip(idxs, pieces):
+                out[i] = piece
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return AsyncTreeHandle(handles, assemble)
+
+
+def device_hier_allreduce_async(xs: jax.Array, mesh: Mesh, op: int = SUM,
+                                axis: Optional[str] = None,
+                                groups=None, wire: Optional[str] = None,
+                                inter_method: str = "ring",
+                                guard=None) -> AsyncHandle:
+    """:func:`device_hier_allreduce`, issued without blocking: the three
+    phase programs dispatch back-to-back as futures, so phase k+1 is
+    enqueued before phase k's wire completes — and, across consecutive
+    calls, bucket i's slow inter-host swing/ring phase overlaps bucket
+    i+1's intra-host reduce-scatter on-device (the phases touch
+    different links, so the fabric genuinely parallelizes them). Each
+    phase still gets its own ``.issue`` span, cost stamp, and the shared
+    round id; the single watchdog ``guard`` covers issue→completion of
+    the whole schedule (per-phase deadlines need a blocking boundary to
+    measure against — use the sync variant for that)."""
+    if axis is None:
+        axis = mesh.axis_names[0]
+    p = mesh.shape[axis]
+    groups = _topology.resolve_groups(p, explicit=groups)
+    if not _topology.is_hierarchical(groups, p):
+        if groups and len(groups) == 1:
+            wire = None
+        flat = "swing" if inter_method == "swing" else "ring"
+        return device_allreduce_async(xs, mesh, op=op, axis=axis,
+                                      method=flat, wire=wire or "none",
+                                      guard=guard)
+    adapted = None
+    if _skew.adapt_enabled():
+        _skew_sync_point(mesh, axis)
+        plan = _skew.adapt_plan("hier", p, int(np.prod(xs.shape[1:]))
+                                * xs.dtype.itemsize,
+                                OP_NAMES.get(op, str(op)), groups=groups,
+                                digest=_skew.monitor().applied())
+        if plan is not None:
+            groups = plan["groups"]
+            adapted = f"{plan['kind']}@{plan['laggard']}"
+        _skew.note_applied(adapted)
+    g, hosts = len(groups[0]), len(groups)
+    slots = _topology.slot_rings(groups)
+    shape = xs.shape[1:]
+    n = int(np.prod(shape))
+    itemsize = xs.dtype.itemsize
+    wire = None if wire in (None, "none", "auto") else wire
+    wire = _normalize_wire(wire, op, xs.dtype)
+    mult = p * _INT8_BLOCK if wire == "int8" else p
+    n_pad = n + (-n) % mult
+    rnd = telemetry.collective_round("hier_allreduce")
+    opname = OP_NAMES.get(op, str(op))
+
+    def _issue(name, phase, nbytes, mth, w, cost_n, cost_axis,
+               cost_phase, fn, *args):
+        cost = _profile.record_cost(name, mth, w, cost_n, itemsize,
+                                    cost_axis, phase=cost_phase,
+                                    group_size=g)
+        extra = ({"cost_flops": cost["flops"],
+                  "cost_wire_bytes": cost["wire_bytes"],
+                  "cost_hops": cost["hops"]} if cost else {})
+        if adapted:
+            extra["adapted"] = adapted
+        with telemetry.span(name + ".issue", nbytes=nbytes, op=opname,
+                            method=mth, wire=w, round=rnd, phase=phase,
+                            hosts=hosts, group_size=g, **extra):
+            with _profile.jit_probe(name, fn):
+                return fn(*args)
+
+    telemetry.count("async.issued", nbytes=n * itemsize, op=opname,
+                    method="hier", wire=wire)
+    mid = _issue("hier.reduce_scatter", "reduce_scatter",
+                 n * itemsize, "ring", None, n, g, "rs",
+                 _hier_rs_global, xs, mesh, axis, op, groups, mult)
+    mid = _issue("hier.inter", "inter",
+                 (n_pad // g) * itemsize, inter_method, wire,
+                 n_pad // g, hosts, None,
+                 _hier_inter_global, mid, mesh, axis, op, slots, wire,
+                 inter_method)
+    out = _issue("hier.allgather", "allgather",
+                 n * itemsize, "ring", None, n_pad, g, "ag",
+                 _hier_ag_global, mid, mesh, axis, groups)
+    attrs = {"op": opname, "method": "hier", "wire": wire, "round": rnd,
+             "hosts": hosts, "group_size": g, "async": 1}
+    if adapted:
+        attrs["adapted"] = adapted
+    return AsyncHandle(out, name="hier_allreduce", nbytes=n * itemsize,
+                       attrs=attrs, guard=guard,
+                       postprocess=lambda o: o[:n].reshape(shape))

@@ -36,6 +36,21 @@ ROUND_SPAN_NAMES = ("engine.allreduce", "engine.broadcast",
                     "hier.inter", "hier.allgather")
 
 
+def _exposed_dur(attrs: dict, raw_dur: float) -> float:
+    """A span's contribution to the critical path. Async-overlapped
+    collectives stamp ``wire_exposed_ms`` — the wall time the caller
+    actually blocked, with the portion hidden behind compute already
+    subtracted; when present it replaces the raw duration so overlap
+    doesn't inflate the tables."""
+    exp = attrs.get("wire_exposed_ms")
+    if exp is None:
+        return raw_dur
+    try:
+        return float(exp) / 1e3
+    except (TypeError, ValueError):
+        return raw_dur
+
+
 def _records_from_spans(spans: Iterable[dict],
                         t_base_unix: float) -> List[dict]:
     out = []
@@ -48,7 +63,7 @@ def _records_from_spans(spans: Iterable[dict],
                     "phase": attrs.get("phase"),
                     "adapted": attrs.get("adapted"),
                     "t_wall": t_base_unix + float(s.get("t0", 0.0)),
-                    "dur": float(s.get("dur", 0.0))})
+                    "dur": _exposed_dur(attrs, float(s.get("dur", 0.0)))})
     return out
 
 
@@ -66,7 +81,8 @@ def _records_from_trace(doc: dict) -> List[dict]:
                     "phase": args.get("phase"),
                     "adapted": args.get("adapted"),
                     "t_wall": base + float(ev.get("ts", 0.0)) / 1e6,
-                    "dur": float(ev.get("dur", 0.0)) / 1e6})
+                    "dur": _exposed_dur(args,
+                                        float(ev.get("dur", 0.0)) / 1e6)})
     return out
 
 

@@ -187,6 +187,7 @@ class Profiler:
             self._compile: Dict[str, Dict[str, float]] = {}
             self._cache: Dict[str, Dict[str, int]] = {}
             self._cost: Dict[tuple, Dict[str, int]] = {}
+            self._overlap: Dict[tuple, Dict[str, float]] = {}
             self._mem: Dict[str, int] = {
                 "live_bytes": 0, "peak_bytes": 0, "arrays": 0, "samples": 0}
 
@@ -242,6 +243,23 @@ class Profiler:
             c["wire_bytes"] += est["wire_bytes"]
         return est
 
+    def record_overlap(self, name: str, method: Optional[str],
+                       exposed_s: float, overlapped_s: float) -> None:
+        """One completed async collective's exposed-vs-hidden wire
+        split (measured by the handle at ``wait()``): ``exposed_s`` is
+        wall time the caller actually blocked, ``overlapped_s`` is wire
+        time hidden behind whatever ran between issue and wait. Served
+        as the ``rabit_collective_overlap_*`` families."""
+        if not self._enabled:
+            return
+        key = (name, method or "")
+        with self._lock:
+            c = self._overlap.setdefault(
+                key, {"count": 0, "exposed_ms": 0.0, "overlapped_ms": 0.0})
+            c["count"] += 1
+            c["exposed_ms"] += exposed_s * 1e3
+            c["overlapped_ms"] += overlapped_s * 1e3
+
     # --------------------------------------------------------- memory
 
     def sample_memory(self) -> Optional[Dict[str, int]]:
@@ -295,6 +313,11 @@ class Profiler:
                      "count": c["count"], "flops": c["flops"],
                      "wire_bytes": c["wire_bytes"]}
                     for k, c in sorted(self._cost.items())],
+                "overlap": [
+                    {"name": k[0], "method": k[1], "count": c["count"],
+                     "exposed_ms": c["exposed_ms"],
+                     "overlapped_ms": c["overlapped_ms"]}
+                    for k, c in sorted(self._overlap.items())],
                 "device_mem": dict(self._mem),
             }
 
@@ -339,6 +362,11 @@ def record_cost(name: str, method: Optional[str], wire: Optional[str],
     return _PROFILER.record_cost(name, method, wire, n, itemsize,
                                  axis_size, phase=phase,
                                  group_size=group_size)
+
+
+def record_overlap(name: str, method: Optional[str], exposed_s: float,
+                   overlapped_s: float) -> None:
+    _PROFILER.record_overlap(name, method, exposed_s, overlapped_s)
 
 
 def sample_memory():

@@ -61,6 +61,10 @@ METRIC_FAMILIES = (
     "rabit_device_mem_live_bytes",
     "rabit_device_mem_peak_bytes",
     "rabit_device_mem_arrays",
+    # async overlap accounting (telemetry/profile.py, ISSUE 11)
+    "rabit_collective_overlap_ops_total",
+    "rabit_collective_overlap_exposed_ms_total",
+    "rabit_collective_overlap_hidden_ms_total",
     # engine extra gauges (engine/xla.py, engine/native.py)
     "rabit_watchdog_expired_total",
     "rabit_world_epoch",
@@ -194,6 +198,15 @@ def render_prometheus(sources: Iterable[Tuple[Dict[str, str], dict]],
         "mem_arrays": _Family("rabit_device_mem_arrays",
                               "Live jax arrays at the last sample.",
                               "gauge"),
+        "ovl_ops": _Family("rabit_collective_overlap_ops_total",
+                           "Async collectives completed per "
+                           "(name,method).", "counter"),
+        "ovl_exposed": _Family("rabit_collective_overlap_exposed_ms_total",
+                               "Wire milliseconds the caller actually "
+                               "blocked on (wait time).", "counter"),
+        "ovl_hidden": _Family("rabit_collective_overlap_hidden_ms_total",
+                              "Wire milliseconds hidden behind compute "
+                              "between issue and wait.", "counter"),
     }
     for base, doc in sources:
         base = dict(base or {})
@@ -243,6 +256,15 @@ def render_prometheus(sources: Iterable[Tuple[Dict[str, str], dict]],
                 fams["cost_flops"].add(labels, int(row.get("flops", 0)))
                 fams["cost_bytes"].add(labels,
                                        int(row.get("wire_bytes", 0)))
+            for row in prof.get("overlap", []):
+                labels = dict(base)
+                for f in ("name", "method"):
+                    labels[f] = str(row.get(f, "") or "")
+                fams["ovl_ops"].add(labels, int(row.get("count", 0)))
+                fams["ovl_exposed"].add(
+                    labels, float(row.get("exposed_ms", 0.0)))
+                fams["ovl_hidden"].add(
+                    labels, float(row.get("overlapped_ms", 0.0)))
             mem = prof.get("device_mem") or {}
             if mem.get("samples"):
                 fams["mem_live"].add(base, int(mem.get("live_bytes", 0)))
@@ -252,7 +274,8 @@ def render_prometheus(sources: Iterable[Tuple[Dict[str, str], dict]],
     order = ("count", "bytes", "secs", "max", "hist", "recorded",
              "dropped", "capacity", "enabled", "compile_n", "compile_s",
              "compile_max", "jit_hits", "jit_misses", "cost_flops",
-             "cost_bytes", "mem_live", "mem_peak", "mem_arrays")
+             "cost_bytes", "ovl_ops", "ovl_exposed", "ovl_hidden",
+             "mem_live", "mem_peak", "mem_arrays")
     for key in order:
         lines.extend(fams[key].lines())
     for name, help_text, mtype, samples in gauges:

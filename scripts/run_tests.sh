@@ -69,6 +69,13 @@ echo "== tier 0i: tracker-WAL smoke (journal -> crash -> resume) =="
 # world — plus the chaos tracker_kill hook path (part of tier 0c)
 python -m rabit_tpu.tracker.wal --smoke
 
+echo "== tier 0j: async-dispatch smoke (issue -> overlap -> await) =="
+# device_allreduce_async round-trip on a 1-host virtual mesh: bit-parity
+# with the sync schedule, double-wait idempotency, and a live watchdog
+# deadline armed per in-flight op (and never tripped); plus the hier
+# three-phase pipeline behind one awaitable
+JAX_PLATFORMS=cpu python tools/overlap_bench.py --smoke
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

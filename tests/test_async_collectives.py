@@ -1,0 +1,282 @@
+"""Async overlapped collectives: handle lifecycle, bit-parity with the
+sync schedules, the max-in-flight admission window, and the off-by-
+default contract (``rabit_async_collectives`` unset => the bucketed
+model steps trace byte-identical programs and zero async counters
+fire)."""
+
+import gc
+import os
+import re
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from rabit_tpu import telemetry
+from rabit_tpu.engine.base import AllreduceHandle
+from rabit_tpu.models import mlp
+from rabit_tpu.models import transformer as tf
+from rabit_tpu.ops.reducers import SUM
+from rabit_tpu.parallel import make_mesh
+from rabit_tpu.parallel import collectives as C
+from rabit_tpu.telemetry import skew
+
+NDEV = len(jax.devices())
+
+pytestmark = pytest.mark.skipif(NDEV < 8, reason="needs 8 virtual devices")
+
+ASYNC_ENV_VARS = ("RABIT_ASYNC_COLLECTIVES", "RABIT_ASYNC_MAX_INFLIGHT")
+
+
+@pytest.fixture(autouse=True)
+def _clean_async_env():
+    saved = {v: os.environ.pop(v, None) for v in ASYNC_ENV_VARS}
+    yield
+    for v, val in saved.items():
+        if val is None:
+            os.environ.pop(v, None)
+        else:
+            os.environ[v] = val
+
+
+@pytest.fixture
+def telem():
+    telemetry.reset(capacity=256, enabled=True)
+    yield
+    telemetry.reset(enabled=False)
+
+
+def _payload(mesh, n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((8, n)).astype(np.float32)
+    return jax.device_put(
+        x, NamedSharding(mesh, P(mesh.axis_names[0])))
+
+
+# ------------------------------------------------- handle lifecycle
+
+
+def test_async_allreduce_matches_sync_bits():
+    mesh = make_mesh(8)
+    xs = _payload(mesh)
+    ref = np.asarray(C.device_allreduce(xs, mesh, SUM, method="ring"))
+    h = C.device_allreduce_async(xs, mesh, SUM, method="ring")
+    assert np.array_equal(ref, np.asarray(h.wait()))
+
+
+def test_double_wait_is_idempotent():
+    mesh = make_mesh(8)
+    xs = _payload(mesh)
+    h = C.device_allreduce_async(xs, mesh, SUM, method="ring")
+    first = np.asarray(h.wait())
+    again = np.asarray(h.wait())
+    assert np.array_equal(first, again)
+    assert C.inflight_count() == 0
+
+
+def test_ready_probe_is_boolean_and_settles():
+    mesh = make_mesh(8)
+    xs = _payload(mesh)
+    h = C.device_allreduce_async(xs, mesh, SUM, method="ring")
+    assert isinstance(h.ready(), bool)
+    h.wait()
+    assert h.ready() is True
+
+
+def test_drop_without_wait_warns(telem):
+    mesh = make_mesh(8)
+    xs = _payload(mesh)
+    h = C.device_allreduce_async(xs, mesh, SUM, method="ring")
+    with pytest.warns(RuntimeWarning, match="dropped"):
+        del h
+        gc.collect()
+    names = [c["name"] for c in telemetry.snapshot()["counters"]]
+    assert "async.dropped_handle" in names
+    assert C.inflight_count() == 0
+
+
+def test_max_inflight_admission_window():
+    os.environ["RABIT_ASYNC_MAX_INFLIGHT"] = "2"
+    assert C.async_max_inflight() == 2
+    mesh = make_mesh(8)
+    handles = [C.device_allreduce_async(_payload(mesh, seed=i), mesh, SUM,
+                                        method="ring") for i in range(4)]
+    # the window never exceeds the cap: issuing #3 forced a wait on #1
+    assert C.inflight_count() <= 2
+    assert handles[0].ready()
+    for h in handles:
+        h.wait()
+    assert C.inflight_count() == 0
+
+
+def test_engine_handle_sync_fallback():
+    buf = np.arange(8, dtype=np.float64)
+    h = AllreduceHandle(value=buf)
+    assert h.ready() is True
+    assert h.wait() is buf
+    assert h.wait() is buf  # idempotent, cached
+
+
+def test_hier_async_matches_sync_bits():
+    mesh = make_mesh(8)
+    xs = _payload(mesh, seed=3)
+    groups = ((0, 1, 2, 3), (4, 5, 6, 7))
+    ref = np.asarray(C.device_hier_allreduce(xs, mesh, SUM, groups=groups))
+    h = C.device_hier_allreduce_async(xs, mesh, SUM, groups=groups)
+    assert np.array_equal(ref, np.asarray(h.wait()))
+
+
+def test_bucket_tree_async_matches_sync_leaves():
+    mesh = make_mesh(8)
+    tree = {"a": _payload(mesh, n=300, seed=1),
+            "b": _payload(mesh, n=128, seed=2)}
+    ht = C.bucket_allreduce_async(tree, mesh, SUM)
+    assert sorted(tree) == ["a", "b"]
+    out = ht.wait()
+    for k in tree:
+        ref = np.asarray(C.device_allreduce(tree[k], mesh, SUM,
+                                            method="ring", wire=None))
+        assert np.allclose(ref, np.asarray(out[k]), rtol=1e-6, atol=1e-6)
+    assert ht.ready()
+
+
+def test_issue_order_stable_under_skew_sync_boundary():
+    # skew adaptation ON: the skew-sync agreement point fires at issue
+    # (before dispatch resolve), exactly as in the sync path, so async
+    # rounds cross the boundary in the same program order
+    os.environ["RABIT_SKEW_ADAPT"] = "1"
+    skew.reset_monitor()
+    try:
+        mesh = make_mesh(8)
+        handles, refs = [], []
+        for i in range(3):
+            xs = _payload(mesh, seed=10 + i)
+            refs.append(np.asarray(C.device_allreduce(xs, mesh, SUM,
+                                                      method="ring")))
+            handles.append(C.device_allreduce_async(xs, mesh, SUM,
+                                                    method="ring"))
+        for ref, h in zip(refs, handles):
+            assert np.array_equal(ref, np.asarray(h.wait()))
+    finally:
+        os.environ.pop("RABIT_SKEW_ADAPT", None)
+        skew.reset_monitor()
+
+
+# ------------------------------------------- model steps + the knob
+
+
+def _mlp_mesh():
+    return make_mesh(8, ("dp", "tp"), (4, 2))
+
+
+def test_mlp_async_step_matches_sync_bucket():
+    mesh = _mlp_mesh()
+    params, x, y = mlp.make_sharded_inputs(mesh)
+    p1, l1 = mlp.make_train_step(mesh, grad_sync="bucket")(params, x, y)
+    os.environ["RABIT_ASYNC_COLLECTIVES"] = "1"
+    p2, l2 = mlp.make_train_step(mesh, grad_sync="bucket")(params, x, y)
+    assert np.allclose(float(l1), float(l2), rtol=1e-6)
+    for k in p1:
+        assert np.array_equal(np.asarray(p1[k]), np.asarray(p2[k])), k
+
+
+def test_transformer_async_step_matches_sync_bucket():
+    mesh = make_mesh(8, ("dp", "tp", "sp"), (2, 2, 2))
+    sizes = dict(n_layers=2, d_model=32, n_heads=4, d_head=8, d_ff=64)
+    params, tokens, targets = tf.make_sharded_inputs(
+        mesh, batch=4, seq=32, vocab=64, **sizes)
+    p1, l1 = tf.make_train_step(mesh, lr=0.1, grad_sync="bucket")(
+        params, tokens, targets)
+    os.environ["RABIT_ASYNC_COLLECTIVES"] = "1"
+    p2, l2 = tf.make_train_step(mesh, lr=0.1, grad_sync="bucket")(
+        params, tokens, targets)
+    assert np.allclose(float(l1), float(l2), rtol=1e-6)
+    for k in p1:
+        assert np.array_equal(np.asarray(p1[k]), np.asarray(p2[k])), k
+
+
+def test_knob_unset_program_byte_identical():
+    """Toggling the knob on and off again must leave the traced sync
+    program untouched — the async route is a pre-trace branch, never a
+    different jaxpr for the same call."""
+    mesh = _mlp_mesh()
+    params, x, y = mlp.make_sharded_inputs(mesh)
+
+    def jaxpr_of(step):
+        # object reprs embed memory addresses (fresh closures per
+        # make_train_step call); they are not program bytes
+        return re.sub(r"0x[0-9a-f]+", "0x", str(
+            jax.make_jaxpr(step)(params, x, y)))
+
+    before = jaxpr_of(mlp.make_train_step(mesh, grad_sync="bucket"))
+    os.environ["RABIT_ASYNC_COLLECTIVES"] = "1"
+    async_step = mlp.make_train_step(mesh, grad_sync="bucket")
+    assert not hasattr(async_step, "lower")  # python pipeline, not a jit
+    os.environ.pop("RABIT_ASYNC_COLLECTIVES")
+    after = jaxpr_of(mlp.make_train_step(mesh, grad_sync="bucket"))
+    assert before == after
+
+
+def test_knob_unset_fires_zero_async_counters(telem):
+    mesh = _mlp_mesh()
+    params, x, y = mlp.make_sharded_inputs(mesh)
+    step = mlp.make_train_step(mesh, grad_sync="bucket")
+    step(params, x, y)
+    names = [c["name"] for c in telemetry.snapshot()["counters"]]
+    assert not [n for n in names if n.startswith("async.")], names
+
+
+def test_async_enabled_env_parsing():
+    assert not C.async_enabled()
+    for val in ("1", "true", "yes", "on"):
+        os.environ["RABIT_ASYNC_COLLECTIVES"] = val
+        assert C.async_enabled()
+    os.environ["RABIT_ASYNC_COLLECTIVES"] = "0"
+    assert not C.async_enabled()
+    os.environ["RABIT_ASYNC_MAX_INFLIGHT"] = "bogus"
+    assert C.async_max_inflight() == C.ASYNC_MAX_INFLIGHT_DEFAULT
+
+
+def test_async_issue_records_span_and_counter(telem):
+    mesh = make_mesh(8)
+    xs = _payload(mesh, seed=5)
+    h = C.device_allreduce_async(xs, mesh, SUM, method="ring")
+    h.wait()
+    snap = telemetry.snapshot()
+    names = {c["name"] for c in snap["counters"]}
+    assert "async.issued" in names
+    spans = {s["name"]: s for s in snap["spans"]}
+    assert "allreduce.issue" in spans
+    done = spans["allreduce"]
+    attrs = done.get("attrs") or {}
+    assert attrs.get("async") == 1
+    assert "wire_exposed_ms" in attrs and "wire_overlapped_ms" in attrs
+
+
+def test_overlap_profile_accumulates():
+    from rabit_tpu.telemetry import profile as prof
+    try:
+        prof.reset(enabled=True)
+        mesh = make_mesh(8)
+        xs = _payload(mesh, seed=6)
+        C.device_allreduce_async(xs, mesh, SUM, method="ring").wait()
+        snap = prof.snapshot()
+        rows = [r for r in snap.get("overlap", [])
+                if r["name"] == "allreduce"]
+        assert rows and rows[0]["count"] >= 1
+        assert rows[0]["exposed_ms"] >= 0.0
+    finally:
+        prof.reset(enabled=False)
+
+
+def test_no_drop_warning_after_wait():
+    mesh = make_mesh(8)
+    xs = _payload(mesh, seed=7)
+    h = C.device_allreduce_async(xs, mesh, SUM, method="ring")
+    h.wait()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        del h
+        gc.collect()

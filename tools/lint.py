@@ -84,11 +84,14 @@ SPAN_REQUIRED = {
         "device_allreduce", "device_allreduce_tree", "device_broadcast",
         "device_reduce_scatter", "device_allgather",
         "device_hier_allreduce", "_per_shard_allreduce",
-        "preagg_allreduce"},
+        "preagg_allreduce", "device_allreduce_async",
+        "bucket_allreduce_async", "device_hier_allreduce_async",
+        "grad_bucket_allreduce_async"},
     os.path.join("rabit_tpu", "engine", "base.py"): {
         "reduce_scatter", "allgather"},
     os.path.join("rabit_tpu", "engine", "xla.py"): {
-        "allreduce", "broadcast", "reduce_scatter", "allgather"},
+        "allreduce", "broadcast", "reduce_scatter", "allgather",
+        "allreduce_async"},
     os.path.join("rabit_tpu", "engine", "native.py"): {
         "allreduce", "broadcast"},
     os.path.join("rabit_tpu", "engine", "dataplane.py"): {"_allreduce"},
