@@ -90,6 +90,15 @@ int RbtVersionNumber(void);
  * robust engine only. */
 int RbtInitAfterException(void);
 
+/* In-process world resize (elastic membership): re-register with the
+ * tracker and rebuild ring/tree links from the fresh assignment
+ * without process exit. cmd is "recover" (survivor re-forming after an
+ * eviction) or "join" (an evicted rank rejoining at the next epoch
+ * boundary); NULL/"" defaults to "recover". Rank and world size may
+ * both change; the robust engine's world-sized recovery state is reset
+ * while checkpoints and the version counter survive. */
+int RbtResize(const char* cmd);
+
 /* last error message for bindings (empty string if none) */
 const char* RbtGetLastError(void);
 

@@ -72,6 +72,12 @@ int RbtInitAfterException(void) {
   RT_API_END();
 }
 
+int RbtResize(const char* cmd) {
+  RT_API_BEGIN();
+  GetComm()->Resize(cmd && cmd[0] ? cmd : "recover");
+  RT_API_END();
+}
+
 int RbtFinalize(void) {
   RT_API_BEGIN();
   rt::FinalizeComm();

@@ -66,6 +66,11 @@ void Comm::Init(int argc, const char* const* argv) {
   ReconnectLinks("start");
 }
 
+void Comm::Resize(const char* cmd) {
+  if (tracker_uri_.empty()) return;  // single-node: nothing to rewire
+  ReconnectLinks(cmd);
+}
+
 void Comm::Shutdown() {
   if (tracker_uri_.empty()) return;
   if (links_up_) {

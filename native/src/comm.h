@@ -36,6 +36,17 @@ class Comm {
   virtual void Init(int argc, const char* const* argv);
   virtual void Shutdown();
 
+  // In-process world resize (elastic membership): tear down and
+  // re-form the ring/tree link topology from a fresh tracker
+  // assignment WITHOUT process exit — the native mirror of what
+  // epoch_reset(world) does for the Python modules. cmd is "recover"
+  // (a survivor re-forming after an eviction; the tracker treats it as
+  // re-registration of a known rank) or "join" (a previously evicted
+  // rank parking until the next epoch boundary re-admits it). rank_,
+  // world_ and world_epoch_ all come back reassigned; the robust
+  // subclass additionally resets its world-sized recovery state.
+  virtual void Resize(const char* cmd = "recover");
+
   int rank() const { return rank_; }
   int world_size() const { return world_; }
   virtual bool is_distributed() const { return tracker_uri_ != ""; }

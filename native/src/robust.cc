@@ -50,6 +50,27 @@ void RobustComm::Init(int argc, const char* const* argv) {
       : 1;  // <=0: keep every result on every rank
 }
 
+void RobustComm::Resize(const char* cmd) {
+  // Elastic shrink/grow without process exit: the base rewire
+  // reassigns rank_/world_/world_epoch_ from the fresh tracker
+  // assignment; everything below is recovery state whose meaning is
+  // WORLD-SIZED and therefore dead the moment the world changes —
+  // result-log ownership rotates modulo result_round_ (a function of
+  // world_), replayed seqnos pair ranks that may no longer exist, and
+  // replica_local_ slots mirror ring predecessors of the OLD ring.
+  // The global checkpoint and version counter survive untouched: they
+  // are world-shape-independent and version continuity across a
+  // resize is the whole point of resizing in-process.
+  Comm::Resize(cmd);
+  result_round_ = (num_global_replica_ > 0)
+      ? static_cast<uint32_t>(std::max(1, world_ / num_global_replica_))
+      : 1;
+  result_log_.clear();
+  seq_counter_ = 0;
+  bootstrap_cache_.clear();
+  for (auto& s : replica_local_) s.clear();
+}
+
 void RobustComm::InitAfterException() {
   if (!is_distributed()) return;  // single-node: nothing to reset
   CheckAndRecover(NetResult::kReset);

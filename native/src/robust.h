@@ -40,6 +40,7 @@ class RobustComm : public Comm {
   void Init(int argc, const char* const* argv) override;
   void Shutdown() override;
   void InitAfterException() override;
+  void Resize(const char* cmd = "recover") override;
 
  public:
   // consensus word (reference ActionSummary, allreduce_robust.h:200-298):

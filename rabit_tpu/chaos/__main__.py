@@ -97,9 +97,40 @@ def smoke() -> int:
         assert len(fired) == 1, \
             f"expected 1 tracker_kill event: {proxy.events}"
         assert kills == [250.0], f"kill hook saw {kills}"
+
+    # round 3: tracker_partition (ISSUE 12) — tracker-bound bytes stall
+    # inside the window (neither delivered nor refused), then flow; the
+    # rule is implicitly scoped to tracker proxies, so a link-class
+    # schedule never runs it at all
+    part_sched = Schedule([Rule("tracker_partition",
+                                window_s=(0.0, 0.4), max_times=1)], seed=7)
+    assert part_sched.for_target("link").rules == [], \
+        "tracker_partition leaked onto link proxies"
+    assert len(part_sched.for_target("tracker").rules) == 1
+    with ChaosProxy(host, port, part_sched.for_target("tracker"),
+                    name="chaos-smoke-part") as proxy:
+        import time as _time
+        t0 = _time.monotonic()
+        conn = retry.connect_with_retry(proxy.host, proxy.port, timeout=5.0)
+        with conn:
+            conn.sendall(payload)
+            conn.shutdown(socket.SHUT_WR)
+            out = b""
+            while True:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    break
+                out += chunk
+        took = _time.monotonic() - t0
+        assert out == payload, f"torn echo: {len(out)}/{len(payload)}"
+        stalls = [e for e in proxy.events if e[1] == "tracker_partition"]
+        assert len(stalls) == 1, \
+            f"expected 1 tracker_partition event: {proxy.events}"
+        assert took >= 0.35, \
+            f"partition window never stalled the stream ({took:.2f}s)"
     srv.close()
-    print("chaos smoke ok (1 reset + 1 tracker_kill injected, retry "
-          "recovered, payload intact)")
+    print("chaos smoke ok (1 reset + 1 tracker_kill + 1 "
+          "tracker_partition injected, retry recovered, payload intact)")
     return 0
 
 

@@ -163,6 +163,16 @@ class ChaosProxy:
         self.stop()
         return False
 
+    def retarget(self, host: str, port: int) -> None:
+        """Repoint NEW connections at a different upstream (hot-standby
+        failover, ISSUE 12): the promoted tracker owns the world now,
+        and every address baked into a live worker — including the
+        native engine's shutdown path — keeps resolving through this
+        proxy. Established connections are untouched; they belong to
+        the deposed upstream and die with it."""
+        with self._lock:
+            self.upstream = (host, int(port))
+
     def elapsed(self) -> float:
         return time.monotonic() - self._t0
 
@@ -229,8 +239,10 @@ class ChaosProxy:
                 self.refused += 1
                 _hard_close(client)
                 continue
+            with self._lock:
+                upstream_addr = self.upstream  # retarget()-able
             try:
-                upstream = socket.create_connection(self.upstream,
+                upstream = socket.create_connection(upstream_addr,
                                                     timeout=10.0)
             except OSError:
                 # upstream genuinely down: behave like it (RST, since a
@@ -298,7 +310,12 @@ class ChaosProxy:
                 if Schedule.consume(rule):
                     self._event("delay", conn.index)
                     time.sleep(rule.delay_ms / 1e3)
-            elif rule.kind == "partition":
+            elif rule.kind in ("partition", "tracker_partition"):
+                # tracker_partition (ISSUE 12) is the same pump stall,
+                # but the rule is target-scoped to tracker proxies at
+                # schedule level: control-plane bytes hang while link
+                # proxies keep flowing — the shape that must trip
+                # hot-standby failover, not worker recovery
                 stalled = False
                 while self._in_window(rule) and not self._done.is_set() \
                         and not conn.dead:
@@ -306,7 +323,7 @@ class ChaosProxy:
                         stalled = True
                         if not Schedule.consume(rule):
                             break
-                        self._event("partition", conn.index)
+                        self._event(rule.kind, conn.index)
                     time.sleep(0.02)
         with self._lock:
             total = conn.nbytes + len(chunk)

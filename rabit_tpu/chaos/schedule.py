@@ -11,7 +11,7 @@ Rule fields (all optional except ``kind``):
 
 ========== ===========================================================
 ``kind``   ``delay`` | ``reset`` | ``partial`` | ``partition`` |
-           ``blackout`` | ``tracker_kill``
+           ``blackout`` | ``tracker_kill`` | ``tracker_partition``
 ``conn``   apply only to the nth accepted connection (0-based);
            ``None`` = every connection
 ``prob``   apply with this probability (seeded draw); default 1.0
@@ -31,7 +31,12 @@ Rule fields (all optional except ``kind``):
            shape: the proxy's upstream tracker is killed and — when a
            WAL is configured — respawned with ``--resume`` after
            ``delay_ms``; requires ``window_s`` or ``conn``, defaults
-           ``max_times`` to 1)
+           ``max_times`` to 1), ``tracker_partition`` stalls only
+           tracker-bound connections inside the window while link
+           proxies keep flowing (the leader-partition shape: the data
+           plane is healthy, the control plane is unreachable — what
+           hot-standby failover must catch; requires ``window_s``,
+           implicitly ``target="tracker"`` unless overridden)
 ``target``  ``"tracker"`` | ``"link"`` | ``None`` (both, the
            default): which proxy class runs the rule. Link wiring has
            no retry around an accepted-then-reset handshake (a peer
@@ -51,7 +56,7 @@ import random
 from typing import List, Optional, Sequence, Tuple
 
 KINDS = ("delay", "reset", "partial", "partition", "blackout",
-         "tracker_kill")
+         "tracker_kill", "tracker_partition")
 TARGETS = ("tracker", "link")
 
 
@@ -68,8 +73,14 @@ class Rule:
         if kind not in KINDS:
             raise ValueError(f"chaos rule kind must be one of {KINDS}, "
                              f"got {kind!r}")
-        if kind in ("partition", "blackout") and window_s is None:
+        if kind in ("partition", "blackout", "tracker_partition") \
+                and window_s is None:
             raise ValueError(f"chaos {kind!r} rule requires window_s")
+        if kind == "tracker_partition" and target is None:
+            # "partition the LEADER, not the world": by construction
+            # this rule stalls only tracker-bound connections — link
+            # proxies never run it unless a test explicitly retargets
+            target = "tracker"
         if kind == "tracker_kill":
             # the kill must be anchored (a window or a specific
             # connection) or the very FIRST accept — registration —

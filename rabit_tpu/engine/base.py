@@ -166,6 +166,18 @@ class Engine(ABC):
         raise NotImplementedError(
             "InitAfterException requires the robust engine")
 
+    def resize(self, cmd: str = "recover") -> None:
+        """In-process world resize (elastic membership): re-register
+        with the tracker and rebuild the link topology from the fresh
+        assignment without process exit — rank and world size may both
+        change. ``cmd`` is ``"recover"`` (a survivor re-forming after an
+        eviction) or ``"join"`` (an evicted rank rejoining at the next
+        epoch boundary). Only engines with a tracker-registered link
+        plane can honor it; checkpoints and the version counter survive
+        the transition."""
+        raise NotImplementedError(
+            "in-process resize requires a tracker-registered engine")
+
     # -- properties -------------------------------------------------------
     _version: int = 0
 

@@ -104,6 +104,8 @@ def _load() -> ctypes.CDLL:
     lib.RbtSetDataPlane.argtypes = [
         DATAPLANE_CB, ctypes.c_void_p, ctypes.c_uint64]
     lib.RbtWorldEpoch.restype = ctypes.c_int
+    lib.RbtResize.argtypes = [ctypes.c_char_p]
+    lib.RbtResize.restype = ctypes.c_int
     lib.RbtCoordAddr.argtypes = [
         ctypes.c_char_p, ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t]
     lib.RbtAllreduceRaw.argtypes = [
@@ -638,6 +640,42 @@ class NativeEngine(Engine):
                 # same signal as the Python-side engines (base.py)
                 raise NotImplementedError(str(e)) from None
             raise
+
+    def resize(self, cmd: str = "recover") -> None:
+        """In-process world resize: re-register with the tracker and
+        rebuild the C++ link topology (RbtResize -> ReconnectLinks),
+        then run the same Python-side ``epoch_reset(world)`` chain an
+        elastic transition triggers — so a shrink/grow is end-to-end
+        in-process and never burns a worker's respawn budget. The rank
+        and world size this engine reports may both change across the
+        call; robust recovery state keyed on the old world is reset in
+        C++ while checkpoints and the version counter survive."""
+        if cmd not in ("recover", "join"):
+            raise ValueError(f"resize cmd must be 'recover' or 'join', "
+                             f"got {cmd!r}")
+        from ..telemetry import flight as _fl
+        old_world = self.world_size
+        with self._watchdog.guard("engine.resize",
+                                  on_expire=self._on_stall), \
+                telemetry.span("engine.resize", op=cmd,
+                               provenance="membership"):
+            self._check(self._lib.RbtResize(cmd.encode()), "resize")
+        world = self.world_size
+        log.set_identity(self.rank, world)
+        if self.is_distributed:
+            # refresh the formed identity the `resume` handshake
+            # re-presents: the new epoch may have renamed this rank
+            from ..tracker import membership as _mship
+            _mship.note_identity(
+                os.environ.get("RABIT_TASK_ID", str(self.rank)),
+                self.rank, 0)
+        # epoch_reset drops everything keyed on the old world (skew
+        # digest, dispatch tables, host grouping, membership baseline)
+        # and protects the newest old-world checkpoint from pruning
+        self.epoch_reset(world)
+        _fl.note("native_resize",
+                 f"{cmd}: world {old_world} -> {world} "
+                 f"(rank {self.rank}, epoch {self.world_epoch})")
 
     @property
     def rank(self) -> int:

@@ -84,6 +84,10 @@ METRIC_FAMILIES = (
     # crash-recoverable tracker (tracker/tracker.py, ISSUE 10)
     "rabit_tracker_restarts_total",
     "rabit_wal_records_total",
+    # hot-standby control plane (tracker/tracker.py, ISSUE 12)
+    "rabit_tracker_role",
+    "rabit_repl_acked_seq",
+    "rabit_repl_lag_records",
 )
 
 

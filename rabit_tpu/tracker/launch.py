@@ -19,7 +19,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
-from .tracker import Tracker
+from . import standby as _standby_mod
+from .tracker import Tracker, default_lease_ms
 
 
 class _ChaosFarm:
@@ -91,6 +92,13 @@ class _TrackerSupervisor:
         self._factory = factory  # (host, port) -> resumed Tracker
         self.quiet = quiet
         self.restarts = 0
+        # hot standby (ISSUE 12): when a StandbyTracker shadows this
+        # leader, failover replaces cold respawn — the supervisor's job
+        # flips from "fork a successor" to "adopt the promoted standby
+        # and never fork a second tracker into a healthy world"
+        self.standby: Optional[_standby_mod.StandbyTracker] = None
+        self.proxy = None            # chaos front proxy, for retarget
+        self.failovers = 0
         self._lock = threading.Lock()
         self._respawn_at: Optional[float] = None
 
@@ -111,13 +119,66 @@ class _TrackerSupervisor:
             if self.wal_dir is not None:
                 self._respawn_at = time.monotonic() + delay_ms / 1e3
 
+    def _leader_alive(self) -> bool:
+        """Probe for a live leader OTHER than the one we supervise
+        before cold-respawning: a promoted standby legitimately owns
+        the tracker role now. Prefer the ``/healthz`` identity probe
+        (it works for an out-of-process standby too); fall back to
+        in-process promotion state."""
+        sb = self.standby
+        if sb is None:
+            return False
+        tr = sb.tracker
+        if tr is not None and tr.live_addr() is not None:
+            from ..telemetry import live as _live
+            doc = _live.scrape_json(*tr.live_addr(), path="/healthz")
+            return bool(doc and doc.get("ok")
+                        and doc.get("tracker_role") == "leader")
+        return tr is not None and not tr.crashed
+
+    def _adopt_locked(self) -> None:
+        """A standby promoted itself: it IS the tracker now. Fence the
+        deposed incarnation (it may still be listening after a mere
+        partition), repoint the chaos front proxy so addresses baked
+        into live workers — including the native engine's shutdown
+        path — keep resolving, and cancel any scheduled respawn."""
+        fresh = self.standby.tracker
+        old, self.tracker = self.tracker, fresh
+        self.failovers += 1
+        self._respawn_at = None
+        if not old.crashed:
+            old.crash()
+        if self.proxy is not None:
+            self.proxy.retarget(fresh.host, fresh.port)
+        if not self.quiet:
+            print(f"[launch] standby promoted: tracker now "
+                  f"{fresh.host}:{fresh.port} (failover "
+                  f"{self.failovers}, seq {self.standby.acked_seq})",
+                  file=sys.stderr, flush=True)
+
     def poll(self) -> None:
         """Called from the launcher's supervision loop, like the
         per-worker ``Popen.poll``s."""
         with self._lock:
+            if (self.standby is not None and self.standby.promoted()
+                    and self.tracker is not self.standby.tracker):
+                self._adopt_locked()
+                return
             if self._respawn_at is None or \
                     time.monotonic() < self._respawn_at:
                 return
+            if self._leader_alive():
+                # a promoted leader already serves this world — never
+                # fork a second tracker into it (adopted next poll)
+                self._respawn_at = None
+                return
+            if self.standby is not None and self.standby.alive():
+                # promotion is bounded by the lease: hold the cold
+                # respawn while the standby is still working toward it
+                self._respawn_at = time.monotonic() + 0.05
+                return
+            # double failure (standby dead too, or none): the PR 10
+            # cold resume on the pinned port is the fallback
             self._respawn_at = None
             host, port = self.tracker.host, self.tracker.port
         # the dead incarnation's listen socket can linger a beat past
@@ -174,16 +235,24 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
                    or any(a == "rabit_elastic=1" for a in cmd))
     farm = _ChaosFarm(chaos) if chaos is not None else None
     wal_dir = os.environ.get("RABIT_TRACKER_WAL_DIR") or None
+    # hot standby (ISSUE 12): engaged only when BOTH knobs are set —
+    # an advertised standby address (``rabit_tracker_standby``) and a
+    # WAL dir (replication streams the journal; no journal, nothing to
+    # stream). With either unset, lease_ms stays None and the tracker
+    # is byte-identical to the PR 10 configuration.
+    standby_spec = os.environ.get(_standby_mod.STANDBY_ENV) or None
+    lease_ms = default_lease_ms() if (standby_spec and wal_dir) else None
     tracker = Tracker(
         nworkers, coordinator=coordinator,
         link_rewrite=farm.link_rewrite if farm else None,
-        elastic=elastic, wal_dir=wal_dir).start()
+        elastic=elastic, wal_dir=wal_dir, lease_ms=lease_ms).start()
 
     def _resumed_tracker(host: str, port: int) -> Tracker:
         return Tracker(
             nworkers, host=host, port=port, coordinator=coordinator,
             link_rewrite=farm.link_rewrite if farm else None,
-            elastic=elastic, wal_dir=wal_dir, resume=True)
+            elastic=elastic, wal_dir=wal_dir, resume=True,
+            lease_ms=lease_ms)
 
     sup = _TrackerSupervisor(tracker, wal_dir, _resumed_tracker,
                              quiet=quiet)
@@ -191,6 +260,25 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
     if farm is not None:
         proxy = farm.front_tracker(tracker, kill_hook=sup.kill)
         tracker_addr = (proxy.host, proxy.port)
+        sup.proxy = proxy
+    standby = None
+    if lease_ms:
+        sb_host, sb_port = "127.0.0.1", 0
+        if ":" in standby_spec:     # else truthy "1"/"auto": ephemeral
+            h, _, p = standby_spec.rpartition(":")
+            sb_host, sb_port = (h or "127.0.0.1"), int(p)
+        # the standby follows the leader THROUGH the chaos front proxy:
+        # a ``tracker_partition`` severs replication exactly like it
+        # severs the workers, which is what makes partition failover
+        # honest rather than simulated
+        standby = _standby_mod.StandbyTracker(
+            tracker_addr[0], tracker_addr[1], nworkers,
+            wal_dir=os.path.join(wal_dir, "standby"),
+            host=sb_host, port=sb_port, lease_ms=lease_ms,
+            elastic=elastic,
+            link_rewrite=farm.link_rewrite if farm else None,
+            quiet=quiet).start()
+        sup.standby = standby
     procs: Dict[int, subprocess.Popen] = {}
     # respawn accounting is PER RANK: `attempts[i]` counts every spawn
     # of worker i (exported as RABIT_NUM_TRIAL so mock kill schedules
@@ -211,6 +299,11 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
         # chaos: workers rendezvous through the tracker-front proxy
         env["RABIT_TRACKER_URI"] = tracker_addr[0]
         env["RABIT_TRACKER_PORT"] = str(tracker_addr[1])
+        if standby is not None:
+            # the pre-advertised failover address: worker-side breakers
+            # probe it when the leader goes quiet (telemetry/skew.py)
+            env[_standby_mod.STANDBY_ENV] = \
+                f"{standby.host}:{standby.port}"
         if elastic:
             env["RABIT_ELASTIC"] = "1"
         procs[i] = subprocess.Popen(cmd, env=env)
@@ -290,6 +383,18 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
             stats["tracker_wal"] = {"dir": wal_dir,
                                     "records": tracker.wal_records(),
                                     "restarts": tracker.restarts}
+            # hot-standby accounting (ISSUE 12): failovers are NOT
+            # restarts — a promotion never re-forked anything
+            stats["failover"] = {
+                "standby": standby is not None,
+                "failovers": sup.failovers,
+                "promoted": (standby.promoted()
+                             if standby is not None else False),
+                "acked_seq": (standby.acked_seq
+                              if standby is not None else 0),
+                "resyncs": (standby.resyncs
+                            if standby is not None else 0),
+            }
         for p in procs.values():
             if p.poll() is None:
                 p.kill()
@@ -301,7 +406,10 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
                 print(f"[launch] chaos injected {chaos_stats['events']} "
                       f"fault(s) across {chaos_stats['proxies']} proxies",
                       file=sys.stderr, flush=True)
-        tracker.stop()
+        if standby is not None:
+            standby.stop()  # also stops an adopted (promoted) tracker
+        if standby is None or standby.tracker is not tracker:
+            tracker.stop()
 
 
 def main(argv: Optional[List[str]] = None) -> int:

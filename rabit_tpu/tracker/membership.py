@@ -305,6 +305,23 @@ class MembershipMonitor:
             return None
         doc = fetch_world(self.host, self.port, self.task_id)
         if doc is None:
+            # hot-standby failover (ISSUE 12): before counting the miss
+            # toward an outage, try the pre-advertised standby address —
+            # a promoted standby serving the world doc IS the tracker
+            # now (pre-promotion its port refuses instantly, so this
+            # probe is cheap and the miss stands)
+            from ..utils import retry as _retry
+            sb = _retry.parse_hostport(
+                os.environ.get("RABIT_TRACKER_STANDBY"))
+            if sb is not None and sb != (self.host, self.port):
+                sb_doc = fetch_world(sb[0], sb[1], self.task_id)
+                if sb_doc is not None:
+                    self.host, self.port = sb
+                    with self._lock:
+                        self._misses = 0
+                        self._doc = sb_doc
+                    present_resume(self.host, self.port)
+                    return sb_doc
             with self._lock:
                 self._misses += 1
             return None

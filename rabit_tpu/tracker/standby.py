@@ -1,0 +1,371 @@
+"""Hot-standby tracker: WAL streaming replication + lease-gated
+promotion (ISSUE 12 tentpole).
+
+PR 10 made the tracker crash-*recoverable* — but recovery still costs a
+full respawn-and-replay outage bounded by the supervisor's schedule.
+This module closes the gap to *highly available* (the control-plane bar
+of "Highly Available Data Parallel ML training on Mesh Networks",
+arXiv:2011.03605): a warm follower subscribes to the leader over the
+existing wire protocol (the ``repl`` command), persists every streamed
+WAL record to its own journal, acks each one, and — only after the last
+replicated leadership lease has expired — promotes itself by replaying
+that journal into a full :class:`~rabit_tpu.tracker.tracker.Tracker` on
+the pre-advertised failover address.
+
+Why split-brain is structurally impossible: leadership is a *record in
+the replicated log*, not a lock in memory. The leader journals a lease
+renewal every ``lease_ms/3``; renewals replicate in the same total
+order as every other transition; and the follower's promotion gate is
+"the newest lease I hold durably has expired". At most one unexpired
+lease can exist anywhere, so there is never a moment where two trackers
+both believe they own the world.
+
+Failure model (doc/fault_tolerance.md "Hot standby & failover"):
+
+- leader crash: the repl stream tears (EOF), reconnects are refused,
+  the lease lapses within ``lease_ms`` of the last renewal, and the
+  standby promotes — failover is bounded by the lease, not by the
+  supervisor's respawn schedule;
+- leader partition: renewals stop arriving (the stream stalls rather
+  than tears); the follower's read timeout fires after a full lease of
+  silence and the same expiry gate promotes it;
+- double failure (standby also dead): the supervisor falls back to the
+  PR 10 path — cold respawn with ``--resume`` on the pinned port.
+
+Workers discover the promoted tracker through the PR 10 reannounce
+path: the skew poller's breaker probes the pre-advertised standby
+address (``RABIT_TRACKER_STANDBY``) once the leader stops answering,
+and its dead→alive transition re-presents ``(task_id, stable_rank,
+epoch)`` via ``membership.present_resume`` and replays the endpoint
+announce — zero worker restarts, epoch unchanged.
+
+Stdlib-only, like the rest of the tracker package.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+from typing import Optional, Tuple
+
+from ..utils import retry as _retry
+from . import tracker as _tracker_mod
+from . import wal as _wal_mod
+
+STANDBY_ENV = "RABIT_TRACKER_STANDBY"
+
+
+def standby_addr() -> Optional[Tuple[str, int]]:
+    """The pre-advertised failover address from ``RABIT_TRACKER_STANDBY``
+    (``host:port``), or None when no standby is configured. Worker-side
+    failover discovery (telemetry/skew.py, tracker/membership.py) calls
+    this on every probe so a launcher can repoint it live."""
+    return _retry.parse_hostport(os.environ.get(STANDBY_ENV))
+
+
+class StandbyTracker:
+    """A warm follower of one leader tracker.
+
+    ``start()`` spawns the follow loop: subscribe (``repl`` + last
+    durable seq), persist + ack every streamed frame, track the newest
+    lease, and — once the stream is gone AND the lease expired —
+    promote by replaying the replicated journal into a real
+    :class:`Tracker` bound to the advertised failover address. The
+    failover port is reserved at construction (bound, NOT listening,
+    so probes are refused until promotion) and handed to the promoted
+    tracker.
+    """
+
+    def __init__(self, leader_host: str, leader_port: int, nworkers: int,
+                 wal_dir: str, host: str = "127.0.0.1", port: int = 0,
+                 lease_ms: Optional[int] = None, node_id: str = "standby",
+                 elastic: Optional[bool] = None, link_rewrite=None,
+                 ready_timeout: Optional[float] = None,
+                 metrics_port: Optional[int] = None,
+                 quiet: bool = False):
+        self.leader_host = leader_host
+        self.leader_port = int(leader_port)
+        self.nworkers = int(nworkers)
+        self.wal_dir = str(wal_dir)
+        self.lease_ms = int(lease_ms) if lease_ms \
+            else _tracker_mod.default_lease_ms()
+        self.node_id = str(node_id)
+        self._elastic = elastic
+        self._link_rewrite = link_rewrite
+        self._ready_timeout = ready_timeout
+        self._metrics_port = metrics_port
+        self._quiet = quiet
+        # reserve the failover address now so it can be advertised to
+        # workers before any failure: bound but NOT listening — probes
+        # are refused (the discovery signal for "not promoted yet"),
+        # and the promoted tracker rebinds it the instant we release it
+        self._placeholder = socket.socket(  # noqa: R001 - bound, never connects
+            socket.AF_INET, socket.SOCK_STREAM)
+        self._placeholder.setsockopt(socket.SOL_SOCKET,
+                                     socket.SO_REUSEADDR, 1)
+        self._placeholder.bind((host, int(port)))
+        self.host, self.port = self._placeholder.getsockname()
+        self._wal = _wal_mod.WriteAheadLog(self.wal_dir)
+        self._wal.open(resume=False)
+        self._lease: Optional[dict] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.tracker: Optional[_tracker_mod.Tracker] = None
+        self.acked_seq = 0
+        self.promoted_at: Optional[float] = None
+        self.resyncs = 0
+
+    # -- lifecycle --------------------------------------------------------
+    def start(self) -> "StandbyTracker":
+        self._thread = threading.Thread(
+            target=self._follow_loop, name="rabit-tracker-standby",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._placeholder.close()
+        except OSError:
+            pass
+        if self.tracker is not None:
+            self.tracker.stop()
+        else:
+            self._wal.close()
+
+    def alive(self) -> bool:
+        """True while the standby can still take over: following, or
+        already promoted and serving."""
+        if self.tracker is not None:
+            return not self.tracker.crashed
+        return self._thread is not None and self._thread.is_alive()
+
+    def promoted(self) -> bool:
+        return self.tracker is not None
+
+    def _log(self, msg: str) -> None:
+        if not self._quiet:
+            print(f"[standby {self.node_id}] {msg}", file=sys.stderr,
+                  flush=True)
+
+    # -- the follow loop --------------------------------------------------
+    def _subscribe(self) -> socket.socket:
+        """One ``repl`` subscription from this journal's resync point."""
+        conn = _retry.connect_with_retry(
+            self.leader_host, self.leader_port, timeout=5.0, attempts=1)
+        try:
+            conn.sendall(struct.pack("<I", _tracker_mod.MAGIC))
+            for s in ("repl", self.node_id):
+                b = s.encode()
+                conn.sendall(struct.pack("<I", len(b)) + b)
+            conn.sendall(struct.pack("<I", 0))          # num_attempt
+            ok = struct.unpack("<I", _tracker_mod._recv_all(conn, 4))[0]
+            if ok != 1:
+                raise ConnectionError(
+                    "leader refused replication (no WAL configured?)")
+            conn.sendall(struct.pack("<I", self._wal.seq))
+            # a healthy leader renews its lease every lease_ms/3, so a
+            # full lease of silence means crash or partition — exactly
+            # when the expiry gate below is allowed to fire anyway
+            conn.settimeout(max(0.5, self.lease_ms / 1e3))
+            return conn
+        except BaseException:
+            conn.close()
+            raise
+
+    def _follow_loop(self) -> None:
+        backoff = 0.05
+        while not self._stop.is_set():
+            try:
+                conn = self._subscribe()
+            except (OSError, ConnectionError, _retry.RetryError):
+                conn = None
+            if conn is not None:
+                backoff = 0.05
+                try:
+                    while not self._stop.is_set():
+                        frame = _wal_mod.recv_frame(conn)
+                        if frame is None:
+                            raise ConnectionError("leader closed stream")
+                        seq = self._wal.append_encoded(frame)
+                        _, kind, data = _wal_mod.decode_record(frame)
+                        if kind == _wal_mod.LEASE_KIND:
+                            self._lease = data
+                        conn.sendall(struct.pack("<I", seq))
+                        self.acked_seq = seq
+                except (OSError, ConnectionError, struct.error,
+                        _wal_mod.WalError):
+                    # torn stream, ack lost, or leader gone: resync by
+                    # resubscribing from the last DURABLE seq — every
+                    # acked record is already fsynced, so nothing acked
+                    # can be lost
+                    self.resyncs += 1
+                finally:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+            if self._stop.is_set():
+                return
+            if _wal_mod.lease_expired(self._lease) \
+                    and self._lease is not None:
+                self._promote()
+                return
+            if self._lease is None and conn is None:
+                # never synced at all and the leader is unreachable:
+                # nothing to promote from — keep trying to subscribe
+                pass
+            time.sleep(min(backoff, self.lease_ms / 1e3 / 4))
+            backoff = min(backoff * 2, 0.5)
+
+    # -- promotion --------------------------------------------------------
+    def _promote(self) -> None:
+        """The lease lapsed and the leader is unreachable: replay the
+        replicated journal into a real Tracker on the advertised
+        failover address. The promoted tracker renews the lease under
+        its OWN node id from here on — it is the leader now."""
+        self._wal.close()
+        try:
+            self._placeholder.close()
+        except OSError:
+            pass
+        self._log(f"lease expired ({self._lease}); promoting on "
+                  f"{self.host}:{self.port} from seq {self._wal.seq}")
+        deadline = time.monotonic() + 10
+        while True:
+            if self._stop.is_set():
+                return
+            try:
+                tr = _tracker_mod.Tracker(
+                    self.nworkers, host=self.host, port=self.port,
+                    wal_dir=self.wal_dir, resume=True,
+                    lease_ms=self.lease_ms, node_id=self.node_id,
+                    elastic=self._elastic,
+                    link_rewrite=self._link_rewrite,
+                    ready_timeout=self._ready_timeout,
+                    metrics_port=self._metrics_port)
+                break
+            except OSError:
+                if time.monotonic() > deadline:  # pragma: no cover
+                    self._log("failover port never freed; giving up")
+                    return
+                time.sleep(0.05)
+        tr.promoted = True
+        tr.start()
+        self.tracker = tr
+        self.promoted_at = time.monotonic()
+        self._note_promotion()
+
+    def _note_promotion(self) -> None:
+        """Make a failover observable: counter + span + flight note,
+        mirroring the tracker's own transition notes."""
+        from .. import telemetry
+        from ..telemetry import flight
+        telemetry.count("tracker.failover", provenance="tracker")
+        telemetry.record_span("tracker.failover", 0.0, op="promote",
+                              provenance="tracker",
+                              acked_seq=self.acked_seq,
+                              resyncs=self.resyncs)
+        flight.note("tracker_failover",
+                    f"standby {self.node_id} promoted on "
+                    f"{self.host}:{self.port} at seq {self.acked_seq}")
+        self._log(f"promoted: serving epoch "
+                  f"{self.tracker._epoch} with "
+                  f"{len(self.tracker._ranks)} known ranks")
+
+
+# ------------------------------------------------------------- CI smoke
+
+
+def _smoke() -> None:
+    """CI contract (run_tests.sh tier 0k): an in-process leader+standby
+    pair — one journaled transition replicated and acked, then a leader
+    crash, promotion strictly after the forced lease expiry, and the
+    promoted tracker serving the replicated state on the pre-advertised
+    failover address."""
+    import json
+    import shutil
+    import tempfile
+
+    root = tempfile.mkdtemp(prefix="rabit-standby-smoke-")
+    lease_ms = 400
+    tr = sb = None
+    try:
+        tr = _tracker_mod.Tracker(
+            2, wal_dir=os.path.join(root, "leader"),
+            lease_ms=lease_ms).start()
+        sb = StandbyTracker(tr.host, tr.port, 2,
+                            wal_dir=os.path.join(root, "standby"),
+                            lease_ms=lease_ms, quiet=True).start()
+
+        # one journaled transition: an endpoint announce over the wire
+        c = _retry.connect_with_retry(tr.host, tr.port, timeout=5.0)
+        c.sendall(struct.pack("<I", _tracker_mod.MAGIC))
+        for s in ("endpoint", "0"):
+            b = s.encode()
+            c.sendall(struct.pack("<I", len(b)) + b)
+        c.sendall(struct.pack("<I", 0))
+        payload = json.dumps({"host": "127.0.0.1", "port": 9999,
+                              "rank": 0}).encode()
+        c.sendall(struct.pack("<I", len(payload)) + payload)
+        assert struct.unpack(
+            "<I", _tracker_mod._recv_all(c, 4))[0] == 1
+        c.close()
+
+        # ...replicated AND acked (leases + the endpoint record)
+        deadline = time.monotonic() + 10
+        while sb.acked_seq < tr.repl_stats()["seq"] \
+                or tr.repl_stats()["seq"] == 0:
+            assert time.monotonic() < deadline, "replication never caught up"
+            time.sleep(0.02)
+        assert tr.repl_stats()["subscribers"] == 1
+        assert tr.repl_stats()["lag_records"] == 0
+
+        # crash the leader; promotion may happen only AFTER the lease
+        # the standby holds has expired (bounded by one lease width)
+        lease_at_crash = dict(sb._lease)
+        tr.crash()
+        t0 = time.monotonic()
+        while not sb.promoted():
+            assert time.monotonic() - t0 < 10, "standby never promoted"
+            time.sleep(0.02)
+        assert _wal_mod.lease_expired(lease_at_crash), \
+            "promoted while the leader's lease was still live"
+
+        # the promoted tracker serves the replicated state on the
+        # advertised failover address
+        res = sb.tracker
+        assert (res.host, res.port) == (sb.host, sb.port)
+        assert res._endpoints["0"]["port"] == 9999, res._endpoints
+        assert res.restarts == 1
+        assert res.promoted and res.lease() is not None
+        c = _retry.connect_with_retry(sb.host, sb.port, timeout=5.0)
+        c.sendall(struct.pack("<I", _tracker_mod.MAGIC))
+        for s in ("world", "0"):
+            b = s.encode()
+            c.sendall(struct.pack("<I", len(b)) + b)
+        c.sendall(struct.pack("<I", 0))
+        n = struct.unpack("<I", _tracker_mod._recv_all(c, 4))[0]
+        doc = json.loads(_tracker_mod._recv_all(c, n).decode())
+        c.close()
+        assert doc["world"] == 2, doc
+    finally:
+        if sb is not None:
+            sb.stop()
+        if tr is not None:
+            tr.stop()
+        shutil.rmtree(root, ignore_errors=True)
+    print("failover smoke ok (replicated+acked, lease-gated promotion, "
+          "replicated state served)")
+
+
+if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        _smoke()
+    else:
+        print(__doc__)

@@ -34,6 +34,15 @@ Wire protocol (binary, little-endian, length-prefixed strings):
                    hostname), plus the elected min-rank delegate per
                    host. "{}" before the first assignment. Feeds the
                    hierarchical collectives (parallel/topology.py).
+    repl:          + last_seq u32 after the tracker's 1-ack (hot-standby
+                   replication, ISSUE 12): the follower subscribes with
+                   the newest WAL seq it holds durably and the leader
+                   streams every later record as a raw ``append`` frame
+                   — the exact CRC'd canonical-JSON bytes tracker/wal.py
+                   journals — waiting for a u32 seq ack (bounded by
+                   rabit_repl_ack_timeout_ms) after each before sending
+                   the next. A torn stream resyncs by resubscribing
+                   from the follower's last durable seq.
     skew:          (no extra fields) tracker -> worker: payload str, a
                    JSON {"epoch","offsets_ms","laggard"} fleet skew
                    digest — the tracker-side FleetElection's smoothed,
@@ -147,6 +156,40 @@ def _default_ready_timeout() -> float:
 
 RESUME_GRACE_MS_DEFAULT = 15_000
 
+LEASE_MS_DEFAULT = 2_000
+REPL_ACK_TIMEOUT_MS_DEFAULT = 1_000
+
+
+def default_lease_ms() -> int:
+    """``rabit_lease_ms`` (doc/parameters.md): leadership-lease length.
+    The leader journals a renewal every third of this; a hot standby may
+    only promote itself after the last replicated lease expired, so this
+    bounds failover time from above and split-brain risk to zero."""
+    v = os.environ.get("RABIT_LEASE_MS")
+    if not v:
+        return LEASE_MS_DEFAULT
+    try:
+        return max(100, int(v))
+    except ValueError:
+        raise ValueError(
+            f"RABIT_LEASE_MS must be an integer (ms), got {v!r}")
+
+
+def repl_ack_timeout_ms() -> int:
+    """``rabit_repl_ack_timeout_ms`` (doc/parameters.md): how long the
+    leader waits for a follower's per-record ack before dropping that
+    subscriber (it resyncs by resubscribing from its last durable
+    seq)."""
+    v = os.environ.get("RABIT_REPL_ACK_TIMEOUT_MS")
+    if not v:
+        return REPL_ACK_TIMEOUT_MS_DEFAULT
+    try:
+        return max(50, int(v))
+    except ValueError:
+        raise ValueError(
+            f"RABIT_REPL_ACK_TIMEOUT_MS must be an integer (ms), "
+            f"got {v!r}")
+
 
 def resume_grace_ms() -> int:
     """``rabit_tracker_resume_grace_ms`` (doc/parameters.md): how long
@@ -172,7 +215,9 @@ class Tracker:
                  metrics_port: Optional[int] = None,
                  elastic: Optional[bool] = None,
                  wal_dir: Optional[str] = None,
-                 resume: bool = False):
+                 resume: bool = False,
+                 lease_ms: Optional[int] = None,
+                 node_id: str = "leader"):
         self.nworkers = nworkers
         # elastic world membership (ISSUE 9): when on, the tracker is
         # the membership authority for the live job — dead ranks are
@@ -278,14 +323,35 @@ class Tracker:
         self.crashed = False
         self._grace_until = 0.0
         self._resumed_ranks: set = set()
+        # hot-standby leadership + WAL streaming replication (ISSUE 12):
+        # only engaged when ``lease_ms`` is set (the launcher passes it
+        # through ``rabit_tracker_standby``). The leader journals a
+        # lease renewal every lease_ms/3 and streams every WAL record
+        # to ``repl`` subscribers; with lease_ms unset none of this
+        # exists — no lease records, no extra threads, no new gauges —
+        # so a PR 10 configuration is byte-identical.
+        self.lease_ms = int(lease_ms) if lease_ms else None
+        self.node_id = str(node_id)
+        self.promoted = False       # set by a standby before start()
+        self._lease: Optional[dict] = None
+        self._lease_thread: Optional[threading.Thread] = None
+        # the replication side never touches self._lock (``_wal`` runs
+        # under it in several paths): frames live under their own
+        # condition, appended by ``_wal`` and drained per-subscriber
+        self._repl_cv = threading.Condition()
+        self._repl_log: List[bytes] = []    # frame i carries seq i+1
+        self._repl_subs: List[dict] = []
         if wal_dir is not None:
             self._wal_log = _wal_mod.WriteAheadLog(wal_dir)
             records = self._wal_log.open(resume=resume)
+            self._repl_log = [
+                _wal_mod.encode_record(i + 1, kind, data)
+                for i, (kind, data) in enumerate(records)]
             if resume:
                 self._replay(records)
                 self.restarts += 1
-                self._wal_log.record("resume", restarts=self.restarts,
-                                     epoch=self._epoch)
+                self._wal("resume", restarts=self.restarts,
+                          epoch=self._epoch)
                 self._grace_until = (time.monotonic()
                                      + resume_grace_ms() / 1e3)
                 self._note_resume(len(records))
@@ -322,14 +388,23 @@ class Tracker:
                 self._shutdown_ranks.add(int(data["rank"]))
             elif kind == "resume":
                 self.restarts = int(data.get("restarts", self.restarts))
+            elif kind == _wal_mod.LEASE_KIND:
+                self._lease = dict(data)
 
     def _wal(self, kind: str, **data) -> None:
         """Journal one control-plane transition (no-op when the WAL is
         off). Callers invoke this BEFORE acting on the transition —
         the journal is write-ahead, so a crash between journal and
-        action replays the intent, never loses it."""
+        action replays the intent, never loses it. Every journaled
+        record is also published to ``repl`` subscribers as the exact
+        frame bytes that hit the disk (re-encoding is byte-identical:
+        canonical JSON)."""
         if self._wal_log is not None:
-            self._wal_log.record(kind, **data)
+            seq = self._wal_log.record(kind, **data)
+            frame = _wal_mod.encode_record(seq, kind, data)
+            with self._repl_cv:
+                self._repl_log.append(frame)
+                self._repl_cv.notify_all()
 
     def _note_resume(self, nrecords: int) -> None:
         """Make a tracker resume observable: span + counter + flight
@@ -357,11 +432,104 @@ class Tracker:
         resume (workers are still reconnecting their pollers)."""
         return time.monotonic() < self._grace_until
 
+    # -- leadership lease + WAL replication (ISSUE 12) --------------------
+    def _renew_lease(self) -> None:
+        """Journal a fresh leadership lease. The lease is a RECORD in
+        the replicated log, not a lock in memory: renewals stream to
+        the standby like every transition, and the standby may only
+        promote after the newest lease it holds expired — so at most
+        one unexpired lease exists anywhere (split-brain is
+        structurally impossible)."""
+        lease = _wal_mod.lease_doc(self.node_id, self.lease_ms)
+        self._wal(_wal_mod.LEASE_KIND, **lease)
+        with self._lock:
+            self._lease = lease
+
+    def _lease_loop(self) -> None:
+        """Heartbeat renewals at a third of the lease, so two missed
+        beats still leave the lease live; it lapses only when the
+        leader is genuinely gone (crash) or unreachable (partition)."""
+        period = max(0.05, self.lease_ms / 3000.0)
+        while not self._done.wait(period):
+            if self.crashed:
+                return
+            try:
+                self._renew_lease()
+            except _wal_mod.WalError:  # pragma: no cover - disk death
+                return
+
+    def lease(self) -> Optional[dict]:
+        """The newest lease this tracker journaled (None when the
+        lease machinery is off)."""
+        with self._lock:
+            return None if self._lease is None else dict(self._lease)
+
+    def repl_stats(self) -> dict:
+        """Replication-plane snapshot: journal seq, live subscribers,
+        newest acked seq, and the record lag behind the journal."""
+        seq = 0 if self._wal_log is None else self._wal_log.seq
+        with self._repl_cv:
+            subs = [dict(s) for s in self._repl_subs]
+        acked = max((s["acked"] for s in subs), default=0)
+        return {"seq": seq, "subscribers": len(subs), "acked_seq": acked,
+                "lag_records": max(0, seq - acked)}
+
+    def _serve_repl(self, conn: socket.socket, peer: str) -> None:
+        """One ``repl`` subscriber: stream every WAL record at or past
+        its resync point, one ack per record. Runs on the connection's
+        own ``_handle`` thread for as long as the follower keeps
+        acking; a slow or torn follower is dropped (it resubscribes
+        from its last durable seq — replication must never be able to
+        stall the control plane itself)."""
+        if self._wal_log is None:
+            _send_u32(conn, 0)   # replication requires a journal
+            conn.close()
+            return
+        _send_u32(conn, 1)
+        last = _recv_u32(conn)
+        conn.settimeout(repl_ack_timeout_ms() / 1e3)
+        sub = {"peer": peer, "acked": last}
+        with self._repl_cv:
+            self._repl_subs.append(sub)
+        try:
+            next_seq = last + 1
+            while not self._done.is_set():
+                with self._repl_cv:
+                    while (len(self._repl_log) < next_seq
+                           and not self._done.is_set()):
+                        self._repl_cv.wait(0.2)
+                    if self._done.is_set():
+                        break
+                    frame = self._repl_log[next_seq - 1]
+                conn.sendall(frame)
+                ack = _recv_u32(conn)
+                if ack != next_seq:
+                    break   # confused follower: drop it, it resyncs
+                with self._repl_cv:
+                    sub["acked"] = ack
+                next_seq += 1
+        except (OSError, ConnectionError, struct.error):
+            pass
+        finally:
+            with self._repl_cv:
+                if sub in self._repl_subs:
+                    self._repl_subs.remove(sub)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "Tracker":
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
         self._start_live_plane()
+        if self.lease_ms and self._wal_log is not None:
+            self._renew_lease()
+            self._lease_thread = threading.Thread(
+                target=self._lease_loop, name="rabit-tracker-lease",
+                daemon=True)
+            self._lease_thread.start()
         return self
 
     def join(self, timeout: Optional[float] = None) -> bool:
@@ -370,6 +538,8 @@ class Tracker:
     def stop(self) -> None:
         self._done.set()
         self._poll_stop.set()
+        with self._repl_cv:
+            self._repl_cv.notify_all()  # unblock repl streamers
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None
@@ -399,6 +569,8 @@ class Tracker:
         self.crashed = True
         self._done.set()
         self._poll_stop.set()
+        with self._repl_cv:
+            self._repl_cv.notify_all()  # repl streamers die un-flushed
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None
@@ -493,13 +665,22 @@ class Tracker:
         if self._metrics_port is None:
             return
         from ..telemetry import live
+        identity = {"role": "tracker", "nworkers": self.nworkers}
+        if self.lease_ms:
+            # the supervisor's pre-respawn probe and the worker-side
+            # failover discovery both read this: a tracker that answers
+            # /healthz with tracker_role "leader" IS the control plane
+            # (a promoted standby says so too — that is the point)
+            identity.update({"tracker_role": "leader",
+                             "node": self.node_id,
+                             "promoted": bool(self.promoted)})
         try:
             self._metrics_server = live.MetricsServer(
                 port=self._metrics_port,
                 sources_fn=self._metric_sources,
                 summary_fn=lambda: self.merged_metrics() or {},
                 gauges_fn=self._live_gauges,
-                identity={"role": "tracker", "nworkers": self.nworkers},
+                identity=identity,
                 routes={"/straggler": self._straggler_doc},
             ).start()
         except OSError as e:
@@ -548,6 +729,23 @@ class Tracker:
                 "Control-plane transitions journaled to the tracker "
                 "write-ahead log.", "counter",
                 [({}, self._wal_log.records_total)]))
+        if self.lease_ms and self._wal_log is not None:
+            repl = self.repl_stats()
+            gauges.append((
+                "rabit_tracker_role",
+                "Control-plane role: 1 while this tracker holds the "
+                "leadership lease and serves the world (a promoted "
+                "standby reports 1 too — by then it IS the leader).",
+                "gauge", [({"node": self.node_id}, 1)]))
+            gauges.append((
+                "rabit_repl_acked_seq",
+                "Newest WAL seq a standby has durably acked (0 with "
+                "no subscriber).", "gauge", [({}, repl["acked_seq"])]))
+            gauges.append((
+                "rabit_repl_lag_records",
+                "Journaled records not yet acked by the standby — the "
+                "bounded data loss of a failover right now.",
+                "gauge", [({}, repl["lag_records"])]))
         if self.elastic:
             with self._lock:
                 world_now = self._member.world()
@@ -691,6 +889,13 @@ class Tracker:
                       f"(busy skew {strag['busy_skew_s']:.3f}s)",
                       file=sys.stderr, flush=True)
 
+    def live_addr(self) -> Optional[Tuple[str, int]]:
+        """The live /healthz endpoint's ``(host, port)``, or None when
+        no metrics port is configured — what the supervisor probes
+        before daring a cold respawn (ISSUE 12)."""
+        srv = self._metrics_server
+        return None if srv is None else (srv.host, srv.port)
+
     def live_stats(self) -> dict:
         """Snapshot of the live plane for launchers and tests."""
         with self._lock:
@@ -833,6 +1038,8 @@ class Tracker:
                                          str(doc.get("reason", "")))
                 _send_u32(conn, 1 if ok else 0)
                 conn.close()
+            elif cmd == "repl":
+                self._serve_repl(conn, task_id)
             elif cmd == "join":
                 host = _recv_str(conn)
                 port = _recv_u32(conn)

@@ -54,6 +54,7 @@ import json
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -103,6 +104,106 @@ def encode_record(seq: int, kind: str, data: Dict[str, Any]) -> bytes:
                           "data": data},
                          sort_keys=True, separators=(",", ":")).encode()
     return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+
+
+def decode_record(frame: bytes) -> Tuple[int, str, Dict[str, Any]]:
+    """Inverse of :func:`encode_record`: CRC-check and parse one framed
+    record; raises :class:`WalCorruptError` on any damage. The ``repl``
+    replication stream (ISSUE 12) ships these exact frames, so the
+    follower validates every record with the same rules replay uses."""
+    if len(frame) < _FRAME.size:
+        raise WalCorruptError(f"frame of {len(frame)} bytes is shorter "
+                              f"than the {_FRAME.size}-byte header")
+    length, crc = _FRAME.unpack_from(frame, 0)
+    payload = frame[_FRAME.size:]
+    if length > MAX_RECORD_BYTES:
+        raise WalCorruptError(f"frame claims {length} bytes")
+    if len(payload) != length:
+        raise WalCorruptError(
+            f"frame payload is {len(payload)} bytes, header says {length}")
+    if zlib.crc32(payload) != crc:
+        raise WalCorruptError("frame CRC mismatch")
+    try:
+        doc = json.loads(payload)
+    except ValueError as e:
+        raise WalCorruptError(f"unparseable frame payload: {e}") from e
+    if not isinstance(doc, dict) or not isinstance(doc.get("seq"), int) \
+            or not isinstance(doc.get("kind"), str) \
+            or not isinstance(doc.get("data"), dict):
+        raise WalCorruptError("frame payload has the wrong shape")
+    return doc["seq"], doc["kind"], doc["data"]
+
+
+def recv_frame(sock) -> Optional[bytes]:
+    """Read exactly one framed record off a socket (the replication
+    stream's unit of transfer). Returns the raw frame bytes — header
+    included, byte-identical to what :func:`encode_record` produced on
+    the leader — or ``None`` on clean EOF at a frame boundary. Raises
+    ``ConnectionError`` on a mid-frame EOF and
+    :class:`WalCorruptError` on an insane length claim."""
+    head = b""
+    while len(head) < _FRAME.size:
+        chunk = sock.recv(_FRAME.size - len(head))
+        if not chunk:
+            if head:
+                raise ConnectionError("stream torn inside a frame header")
+            return None
+        head += chunk
+    length, _ = _FRAME.unpack_from(head, 0)
+    if length > MAX_RECORD_BYTES:
+        raise WalCorruptError(f"stream frame claims {length} bytes")
+    payload = b""
+    while len(payload) < length:
+        chunk = sock.recv(length - len(payload))
+        if not chunk:
+            raise ConnectionError("stream torn inside a frame payload")
+        payload += chunk
+    return head + payload
+
+
+# --------------------------------------------------------------- leases
+#
+# Leadership is a lease RECORD in the journal, not a lock in memory: the
+# leader renews by journaling {"owner", "until_ms"} (which replicates to
+# the follower like every other transition), and the follower may only
+# promote itself once the last lease it holds has expired. Because every
+# lease lives in the same totally-ordered replicated log, at most one
+# unexpired lease can exist — split-brain is structurally impossible.
+
+LEASE_KIND = "lease"
+
+
+def lease_doc(owner: str, lease_ms: int,
+              now_ms: Optional[int] = None) -> Dict[str, Any]:
+    """Build one lease record's data: ``owner`` holds leadership until
+    ``until_ms`` (wall-clock epoch milliseconds)."""
+    if now_ms is None:
+        now_ms = int(time.time() * 1000)
+    return {"owner": str(owner), "until_ms": int(now_ms) + int(lease_ms),
+            "lease_ms": int(lease_ms)}
+
+
+def lease_expired(lease: Optional[Dict[str, Any]],
+                  now_ms: Optional[int] = None) -> bool:
+    """True when ``lease`` no longer holds leadership. A missing or
+    malformed lease is expired (no one holds the world)."""
+    if now_ms is None:
+        now_ms = int(time.time() * 1000)
+    if not isinstance(lease, dict):
+        return True
+    try:
+        return int(lease.get("until_ms", 0)) <= int(now_ms)
+    except (TypeError, ValueError):
+        return True
+
+
+def last_lease(records: List[Tuple[str, dict]]
+               ) -> Optional[Dict[str, Any]]:
+    """The newest lease in a replayed ``(kind, data)`` list, or None."""
+    for kind, data in reversed(records):
+        if kind == LEASE_KIND:
+            return data
+    return None
 
 
 class WriteAheadLog:
@@ -179,6 +280,32 @@ class WriteAheadLog:
             os.fsync(self._fh.fileno())
             self.records_total += 1
             return self._seq
+
+    def append_encoded(self, frame: bytes) -> int:
+        """Append one already-framed record (a replicated ``append``
+        frame from the leader) byte-for-byte, after re-validating its
+        CRC and sequence continuity; fsyncs before returning so the ack
+        the follower sends back only ever covers durable records.
+        Returns the record's ``seq``."""
+        seq, _, _ = decode_record(frame)
+        with self._lock:
+            if self._fh is None:
+                raise WalError("journal is not open")
+            if seq != self._seq + 1:
+                raise WalCorruptError(
+                    f"replicated record has seq {seq}, journal is at "
+                    f"{self._seq} (resync from the last acked seq)")
+            self._fh.write(frame)
+            self._fh.flush()
+            os.fsync(self._fh.fileno())
+            self._seq = seq
+            self.records_total += 1
+            return seq
+
+    @property
+    def seq(self) -> int:
+        """Sequence number of the newest durable record (0 = empty)."""
+        return self._seq
 
     # -- replay -----------------------------------------------------------
     def replay(self) -> List[Tuple[str, dict]]:

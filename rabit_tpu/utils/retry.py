@@ -119,6 +119,24 @@ def retry_call(fn: Callable, *, attempts: int = DEFAULT_ATTEMPTS,
         f"{type(last).__name__ if last else 'deadline'}: {last}", last)
 
 
+def parse_hostport(addr: Optional[str],
+                   default_host: str = "127.0.0.1"
+                   ) -> Optional[Tuple[str, int]]:
+    """``"host:port"`` (or ``":port"``) -> ``(host, port)``, or None
+    when the string is empty/malformed. The tolerant parser behind
+    every control-plane address knob (``RABIT_SKEW_TRACKER``,
+    ``RABIT_TRACKER_STANDBY``): a bad address must read as "not
+    configured", never crash a poller thread."""
+    raw = (addr or "").strip()
+    if not raw or ":" not in raw:
+        return None
+    host, _, port = raw.rpartition(":")
+    try:
+        return (host or default_host, int(port))
+    except ValueError:
+        return None
+
+
 def connect_with_retry(host: str, port: int, timeout: float = 10.0,
                        attempts: int = DEFAULT_ATTEMPTS,
                        base_s: float = DEFAULT_BASE_S,

@@ -76,6 +76,14 @@ echo "== tier 0j: async-dispatch smoke (issue -> overlap -> await) =="
 # three-phase pipeline behind one awaitable
 JAX_PLATFORMS=cpu python tools/overlap_bench.py --smoke
 
+echo "== tier 0k: failover smoke (replicate -> crash -> promote) =="
+# an in-process leader+standby pair: the standby subscribes over the
+# repl wire command, one journaled transition streams across and is
+# acked (lag 0), then the leader crashes and the standby promotes on
+# its reserved port only after the journaled lease expired — never
+# while the leader's lease was still live (split-brain gate)
+python -m rabit_tpu.tracker.standby --smoke
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

@@ -1,0 +1,553 @@
+"""Zero-downtime control plane (ISSUE 12): lease encode/expiry, the
+``repl`` WAL streaming protocol (subscribe -> append -> ack ->
+torn-stream resync), lease-gated standby promotion, supervisor
+adoption/fencing, chaos ``tracker_partition``, worker-side failover
+discovery plumbing, knob-off identity, and the R003/T003 lint rows."""
+
+import ast
+import json
+import os
+import socket
+import struct
+import sys
+import time
+
+import pytest
+
+from rabit_tpu.tracker import wal as wal_mod
+from rabit_tpu.tracker.launch import _TrackerSupervisor
+from rabit_tpu.tracker.standby import StandbyTracker, standby_addr
+from rabit_tpu.tracker.tracker import MAGIC as WIRE_MAGIC, Tracker
+from rabit_tpu.utils.retry import parse_hostport
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEASE = 2000     # long: nothing in these tests may expire it by accident
+SHORT = 300      # short: tests that WANT expiry wait one of these
+
+
+# --------------------------------------------------------------- helpers
+
+def _send_u32(s, v):
+    s.sendall(struct.pack("<I", v))
+
+
+def _send_str(s, txt):
+    b = txt.encode()
+    _send_u32(s, len(b))
+    s.sendall(b)
+
+
+def _recv_all(s, n):
+    out = b""
+    while len(out) < n:
+        chunk = s.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("closed")
+        out += chunk
+    return out
+
+
+def _recv_u32(s):
+    return struct.unpack("<I", _recv_all(s, 4))[0]
+
+
+def _announce(tr, task_id, port):
+    """One journaled transition: an ``endpoint`` announce."""
+    c = socket.create_connection((tr.host, tr.port), timeout=10)
+    _send_u32(c, WIRE_MAGIC)
+    _send_str(c, "endpoint")
+    _send_str(c, task_id)
+    _send_u32(c, 0)
+    _send_str(c, json.dumps({"host": "127.0.0.1", "port": port,
+                             "rank": int(task_id)}))
+    assert _recv_u32(c) == 1
+    c.close()
+
+
+def _subscribe(tr, last_seq, node_id="test-follower", timeout=5.0):
+    """Raw ``repl`` subscription; returns the open stream socket."""
+    c = socket.create_connection((tr.host, tr.port), timeout=timeout)
+    _send_u32(c, WIRE_MAGIC)
+    _send_str(c, "repl")
+    _send_str(c, node_id)
+    _send_u32(c, 0)
+    ok = _recv_u32(c)
+    if ok != 1:
+        c.close()
+        return None
+    _send_u32(c, last_seq)
+    return c
+
+
+def _wait(pred, timeout=10.0, msg="condition never held"):
+    deadline = time.monotonic() + timeout
+    while not pred():
+        assert time.monotonic() < deadline, msg
+        time.sleep(0.02)
+
+
+# ------------------------------------------------------------ lease math
+
+def test_lease_doc_and_expiry():
+    doc = wal_mod.lease_doc("leader", 2000, now_ms=1_000_000)
+    assert doc == {"owner": "leader", "until_ms": 1_002_000,
+                   "lease_ms": 2000}
+    assert not wal_mod.lease_expired(doc, now_ms=1_001_999)
+    assert wal_mod.lease_expired(doc, now_ms=1_002_000)   # inclusive edge
+    assert wal_mod.lease_expired(doc, now_ms=1_002_001)
+
+
+def test_missing_or_malformed_lease_is_expired():
+    assert wal_mod.lease_expired(None)
+    assert wal_mod.lease_expired({})
+    assert wal_mod.lease_expired({"until_ms": "soon"})
+    assert wal_mod.lease_expired("not a lease")
+
+
+def test_last_lease_picks_newest():
+    recs = [("assign", {"task": "0"}),
+            (wal_mod.LEASE_KIND, {"owner": "a", "until_ms": 1}),
+            ("epoch", {"epoch": 1}),
+            (wal_mod.LEASE_KIND, {"owner": "b", "until_ms": 2})]
+    assert wal_mod.last_lease(recs)["owner"] == "b"
+    assert wal_mod.last_lease([("epoch", {"epoch": 1})]) is None
+    assert wal_mod.last_lease([]) is None
+
+
+def test_leader_journals_and_renews_lease(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path), lease_ms=SHORT).start()
+    try:
+        first = tr.lease()
+        assert first is not None and first["owner"] == "leader"
+        _wait(lambda: tr.lease()["until_ms"] > first["until_ms"],
+              msg="lease never renewed")
+    finally:
+        tr.stop()
+    replayed = wal_mod.WriteAheadLog(str(tmp_path)).replay()
+    leases = [d for k, d in replayed if k == wal_mod.LEASE_KIND]
+    assert len(leases) >= 2                       # initial + a renewal
+    assert wal_mod.last_lease(replayed)["owner"] == "leader"
+
+
+def test_lease_off_without_wal_or_knob(tmp_path):
+    # lease_ms without a WAL: leases live in the journal, so no journal
+    # means no lease machinery (and no thread to renew into nothing)
+    no_wal = Tracker(2, lease_ms=SHORT).start()
+    # WAL without lease_ms: PR 10 behavior exactly — no lease records
+    no_lease = Tracker(2, wal_dir=str(tmp_path)).start()
+    try:
+        time.sleep(0.3)
+        assert no_wal.lease() is None
+        assert no_lease.lease() is None
+        assert no_wal._lease_thread is None
+        assert no_lease._lease_thread is None
+    finally:
+        no_wal.stop()
+        no_lease.stop()
+    kinds = [k for k, _ in wal_mod.WriteAheadLog(str(tmp_path)).replay()]
+    assert wal_mod.LEASE_KIND not in kinds
+
+
+# ------------------------------------------------------- the repl stream
+
+def test_repl_refused_without_wal():
+    tr = Tracker(2).start()
+    try:
+        assert _subscribe(tr, 0) is None          # ok=0: no journal
+    finally:
+        tr.stop()
+
+
+def test_repl_stream_subscribe_append_ack(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    try:
+        for i in range(3):
+            _announce(tr, str(i), 9000 + i)
+        c = _subscribe(tr, 0)
+        assert c is not None
+        got = []
+        for want in (1, 2, 3):
+            frame = wal_mod.recv_frame(c)
+            seq, kind, data = wal_mod.decode_record(frame)
+            assert seq == want and kind == "endpoint"
+            got.append(data["doc"]["port"])
+            _send_u32(c, seq)                     # ack
+        assert got == [9000, 9001, 9002]
+        _wait(lambda: tr.repl_stats()["acked_seq"] == 3)
+        stats = tr.repl_stats()
+        assert stats["subscribers"] == 1
+        assert stats["lag_records"] == stats["seq"] - 3 == 0
+        # records appended AFTER subscription stream live
+        _announce(tr, "3", 9003)
+        seq, kind, data = wal_mod.decode_record(wal_mod.recv_frame(c))
+        assert (seq, data["doc"]["port"]) == (4, 9003)
+        _send_u32(c, seq)
+        c.close()
+        # a torn follower is only noticed when the next record flows
+        # (the stream is idle-quiet by design); push one through
+        _announce(tr, "4", 9004)
+        _wait(lambda: tr.repl_stats()["subscribers"] == 0)
+    finally:
+        tr.stop()
+
+
+def test_repl_torn_stream_resyncs_from_last_seq(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    try:
+        for i in range(4):
+            _announce(tr, str(i), 9100 + i)
+        c = _subscribe(tr, 0)
+        for want in (1, 2):
+            seq, _, _ = wal_mod.decode_record(wal_mod.recv_frame(c))
+            assert seq == want
+            _send_u32(c, seq)
+        c.close()                                 # torn after acking 2
+        _wait(lambda: tr.repl_stats()["subscribers"] == 0)
+        # resubscribe from the last durable seq: stream resumes at 3,
+        # nothing is replayed twice and nothing is skipped
+        c2 = _subscribe(tr, 2)
+        for want in (3, 4):
+            seq, _, data = wal_mod.decode_record(wal_mod.recv_frame(c2))
+            assert seq == want and data["doc"]["port"] == 9100 + want - 1
+            _send_u32(c2, seq)
+        c2.close()
+    finally:
+        tr.stop()
+
+
+def test_repl_wrong_ack_drops_subscriber(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    try:
+        _announce(tr, "0", 9200)
+        c = _subscribe(tr, 0)
+        wal_mod.recv_frame(c)
+        _send_u32(c, 77)                          # confused follower
+        _wait(lambda: tr.repl_stats()["subscribers"] == 0,
+              msg="wrong-ack subscriber never dropped")
+        c.close()
+    finally:
+        tr.stop()
+
+
+# --------------------------------------------- standby follow + promote
+
+def test_standby_follows_and_acks(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path / "leader"),
+                 lease_ms=LEASE).start()
+    sb = StandbyTracker(tr.host, tr.port, 2,
+                        wal_dir=str(tmp_path / "standby"),
+                        lease_ms=LEASE, quiet=True).start()
+    try:
+        _announce(tr, "0", 9999)
+        _wait(lambda: tr.repl_stats()["seq"] > 0
+              and sb.acked_seq == tr.repl_stats()["seq"],
+              msg="standby never caught up")
+        assert not sb.promoted() and sb.alive()
+        assert sb._lease is not None and sb._lease["owner"] == "leader"
+        # the advertised failover port exists but REFUSES until
+        # promotion — that refusal is the "not promoted yet" signal
+        # worker-side probes ride on
+        with pytest.raises(OSError):
+            socket.create_connection((sb.host, sb.port), timeout=1.0)
+        # every acked record is durable in the standby's own journal
+        replayed = wal_mod.WriteAheadLog(str(tmp_path / "standby")).replay()
+        assert ("endpoint" in [k for k, _ in replayed])
+    finally:
+        sb.stop()
+        tr.stop()
+
+
+def test_standby_resyncs_but_holds_while_lease_live(tmp_path):
+    """A torn stream alone must never promote: with the replicated
+    lease still live the standby resubscribes (resync) instead."""
+    tr = Tracker(2, wal_dir=str(tmp_path / "leader"),
+                 lease_ms=LEASE).start()
+    sb = StandbyTracker(tr.host, tr.port, 2,
+                        wal_dir=str(tmp_path / "standby"),
+                        lease_ms=LEASE, quiet=True).start()
+    try:
+        _wait(lambda: sb.acked_seq > 0)
+        tr.crash()                                # stream tears (EOF)
+        _wait(lambda: sb.resyncs >= 1, msg="torn stream never resynced")
+        assert not sb.promoted()                  # lease still live
+        assert sb.alive()
+    finally:
+        sb.stop()
+        tr.stop()
+
+
+def test_promotion_only_after_lease_expiry(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path / "leader"),
+                 lease_ms=SHORT).start()
+    sb = StandbyTracker(tr.host, tr.port, 2,
+                        wal_dir=str(tmp_path / "standby"),
+                        lease_ms=SHORT, quiet=True).start()
+    try:
+        _announce(tr, "0", 9999)
+        _wait(lambda: sb.acked_seq > 0 and sb._lease is not None)
+        lease_at_crash = dict(sb._lease)
+        tr.crash()
+        _wait(lambda: sb.promoted(), msg="standby never promoted")
+        # the split-brain gate: promotion happened strictly after the
+        # last replicated lease lapsed
+        assert wal_mod.lease_expired(lease_at_crash)
+        res = sb.tracker
+        assert (res.host, res.port) == (sb.host, sb.port)
+        assert res.promoted and res.restarts == 1
+        assert res.lease() is not None            # renewing as itself
+        assert res.lease()["owner"] == "standby"
+        assert res._endpoints["0"]["port"] == 9999
+    finally:
+        sb.stop()
+        tr.stop()
+
+
+# ------------------------------------------------- supervisor adoption
+
+def test_supervisor_adopts_promoted_standby(tmp_path):
+    cold_respawns = []
+
+    def factory(host, port):                      # double-failure path
+        cold_respawns.append((host, port))
+        raise AssertionError("cold respawn must not fire with a "
+                             "live standby")
+
+    tr = Tracker(2, wal_dir=str(tmp_path / "leader"),
+                 lease_ms=SHORT).start()
+    sup = _TrackerSupervisor(tr, str(tmp_path / "leader"), factory,
+                             quiet=True)
+    sb = StandbyTracker(tr.host, tr.port, 2,
+                        wal_dir=str(tmp_path / "standby"),
+                        lease_ms=SHORT, quiet=True).start()
+    sup.standby = sb
+    try:
+        _wait(lambda: sb.acked_seq > 0)
+        assert not sup._leader_alive()            # standby not promoted
+        sup.kill(delay_ms=0.0)                    # chaos tracker_kill
+        # while the standby works toward promotion the supervisor must
+        # DEFER the cold respawn, not fork a second tracker
+        deadline = time.monotonic() + 10
+        while not sb.promoted():
+            assert time.monotonic() < deadline
+            sup.poll()
+            time.sleep(0.02)
+        sup.poll()                                # adopt
+        assert sup.tracker is sb.tracker
+        assert sup.failovers == 1
+        assert sup._leader_alive()                # the promoted standby
+        assert cold_respawns == []
+        assert tr.crashed                         # deposed + fenced
+        sup.poll()                                # idempotent
+        assert sup.failovers == 1
+    finally:
+        sb.stop()
+        tr.stop()
+
+
+def test_leader_alive_false_without_standby(tmp_path):
+    tr = Tracker(2, wal_dir=str(tmp_path)).start()
+    sup = _TrackerSupervisor(tr, str(tmp_path), lambda h, p: None,
+                             quiet=True)
+    try:
+        assert not sup._leader_alive()
+    finally:
+        tr.stop()
+
+
+# -------------------------------------- worker-side failover discovery
+
+def test_parse_hostport():
+    assert parse_hostport("10.0.0.1:9091") == ("10.0.0.1", 9091)
+    assert parse_hostport(" h:1 ") == ("h", 1)
+    assert parse_hostport(":500") == ("127.0.0.1", 500)
+    assert parse_hostport("nocolon") is None
+    assert parse_hostport("h:noport") is None
+    assert parse_hostport("") is None
+    assert parse_hostport(None) is None
+
+
+def test_standby_addr_reads_env(monkeypatch):
+    monkeypatch.delenv("RABIT_TRACKER_STANDBY", raising=False)
+    assert standby_addr() is None
+    monkeypatch.setenv("RABIT_TRACKER_STANDBY", "127.0.0.1:7777")
+    assert standby_addr() == ("127.0.0.1", 7777)
+
+
+def test_skew_poller_fails_over_to_standby(tmp_path, monkeypatch):
+    """End to end at the unit level: the skew poller's miss path must
+    flip the tracker env to a reachable standby address and re-present
+    the worker identity there (the PR 10 reannounce machinery aimed at
+    the promoted tracker)."""
+    from rabit_tpu.telemetry import skew
+    from rabit_tpu.tracker import membership
+
+    # the "promoted standby": a resumable tracker address that answers
+    dead_port_probe = socket.socket()
+    dead_port_probe.bind(("127.0.0.1", 0))
+    dead_addr = dead_port_probe.getsockname()
+    dead_port_probe.close()                       # nothing listens here
+
+    promoted = Tracker(2, wal_dir=str(tmp_path)).start()
+    try:
+        monkeypatch.setenv("RABIT_TRACKER_URI", dead_addr[0])
+        monkeypatch.setenv("RABIT_TRACKER_PORT", str(dead_addr[1]))
+        monkeypatch.setenv("RABIT_SKEW_TRACKER",
+                           f"{dead_addr[0]}:{dead_addr[1]}")
+        monkeypatch.setenv("RABIT_TRACKER_STANDBY",
+                           f"{promoted.host}:{promoted.port}")
+        membership.note_identity("0", 0, 0)
+        mon = skew.SkewMonitor()
+        assert mon._try_failover()
+        assert os.environ["RABIT_SKEW_TRACKER"] == \
+            f"{promoted.host}:{promoted.port}"
+        assert os.environ["RABIT_TRACKER_URI"] == promoted.host
+        assert os.environ["RABIT_TRACKER_PORT"] == str(promoted.port)
+        # already pointing at the standby: nothing further to try
+        assert not mon._try_failover()
+    finally:
+        promoted.stop()
+
+
+def test_membership_monitor_fails_over(tmp_path, monkeypatch):
+    from rabit_tpu.tracker import membership
+
+    promoted = Tracker(2, wal_dir=str(tmp_path), elastic=True).start()
+    try:
+        monkeypatch.setenv("RABIT_TRACKER_STANDBY",
+                           f"{promoted.host}:{promoted.port}")
+        mon = membership.MembershipMonitor("127.0.0.1", 1, "0")  # dead
+        doc = mon.refresh()
+        assert doc is not None                    # served by the standby
+        assert (mon.host, mon.port) == (promoted.host, promoted.port)
+        assert mon._misses == 0
+    finally:
+        promoted.stop()
+
+
+# --------------------------------------------- chaos tracker_partition
+
+def test_tracker_partition_rule_validation():
+    from rabit_tpu.chaos.schedule import Rule, Schedule
+    with pytest.raises(ValueError):
+        Rule("tracker_partition")                 # unanchored stall
+    r = Rule("tracker_partition", window_s=(0.5, 1.0))
+    assert r.target == "tracker"                  # implicit scope
+    assert Rule.from_dict(r.to_dict()).to_dict() == r.to_dict()
+    explicit = Rule("tracker_partition", window_s=(0, 1), target="link")
+    assert explicit.target == "link"
+    sched = Schedule([r, Rule("reset", conn=1)])
+    # the whole point: a tracker partition never leaks onto data links
+    # (unscoped rules still run everywhere, as before)
+    assert [x.kind for x in sched.for_target("link").rules] == ["reset"]
+    assert "tracker_partition" in \
+        [x.kind for x in sched.for_target("tracker").rules]
+
+
+def test_tracker_partition_stalls_connection():
+    from rabit_tpu.chaos.proxy import ChaosProxy
+    from rabit_tpu.chaos.schedule import Rule, Schedule
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    sched = Schedule([Rule("tracker_partition", window_s=(0.0, 0.4),
+                           max_times=1)])
+    with ChaosProxy(*srv.getsockname(), sched.for_target("tracker"),
+                    name="part-test") as proxy:
+        c = socket.create_connection((proxy.host, proxy.port), timeout=5)
+        peer, _ = srv.accept()
+        t0 = time.monotonic()
+        c.sendall(b"ping")
+        peer.settimeout(5.0)
+        assert peer.recv(4) == b"ping"            # stalled, not dropped
+        took = time.monotonic() - t0
+        events = [e[1] for e in proxy.events]
+        c.close()
+        peer.close()
+    srv.close()
+    assert events.count("tracker_partition") == 1
+    assert took >= 0.3                            # held inside the window
+
+
+def test_proxy_retarget_swaps_upstream():
+    from rabit_tpu.chaos.proxy import ChaosProxy
+    from rabit_tpu.chaos.schedule import Schedule
+
+    a, b = socket.socket(), socket.socket()
+    for s in (a, b):
+        s.bind(("127.0.0.1", 0))
+        s.listen(4)
+    with ChaosProxy(*a.getsockname(), Schedule([]),
+                    name="retarget-test") as proxy:
+        c1 = socket.create_connection((proxy.host, proxy.port), timeout=5)
+        a.accept()[0].close()                     # reached upstream A
+        c1.close()
+        proxy.retarget(*b.getsockname())          # failover repoint
+        c2 = socket.create_connection((proxy.host, proxy.port), timeout=5)
+        c2.sendall(b"x")
+        peer, _ = b.accept()                      # reached upstream B
+        peer.settimeout(5.0)
+        assert peer.recv(1) == b"x"
+        peer.close()
+        c2.close()
+    a.close()
+    b.close()
+
+
+# ------------------------------------------------- lint + metric rows
+
+def _lint():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import lint
+    finally:
+        sys.path.pop(0)
+    return lint
+
+
+def _r003(src):
+    lint = _lint()
+    return lint._r003_issues(lint.R003_FILE, ast.parse(src))
+
+
+def test_r003_flags_unjournaled_lease_mutation():
+    issues = _r003("class T:\n"
+                   "    def set_lease(self):\n"
+                   "        self._lease = {'owner': 'x'}\n")
+    assert len(issues) == 1 and issues[0][2] == "R003"
+    assert "set_lease" in issues[0][3]
+
+
+def test_r003_accepts_journaled_lease_mutation():
+    assert _r003("class T:\n"
+                 "    def _renew_lease(self):\n"
+                 "        lease = {'owner': 'x'}\n"
+                 "        self._wal('lease', **lease)\n"
+                 "        self._lease = lease\n") == []
+
+
+def test_failover_metric_families_registered():
+    from rabit_tpu.telemetry.prom import METRIC_FAMILIES
+    assert "rabit_tracker_role" in METRIC_FAMILIES
+    assert "rabit_repl_acked_seq" in METRIC_FAMILIES
+    assert "rabit_repl_lag_records" in METRIC_FAMILIES
+
+
+# ----------------------------------------------------- engine resize API
+
+def test_engine_base_resize_default_raises():
+    from rabit_tpu.engine.base import Engine
+    with pytest.raises(NotImplementedError):
+        Engine.resize(object())
+
+
+@pytest.mark.skipif(
+    not os.path.isfile(os.path.join(
+        ROOT, "native", "build", "librabit_tpu_core.so")),
+    reason="native library not built")
+def test_native_resize_binding():
+    from rabit_tpu.engine.native import NativeEngine
+    eng = NativeEngine()
+    assert hasattr(eng._lib, "RbtResize")         # ABI exports the hook
+    with pytest.raises(ValueError):
+        eng.resize("explode")                     # recover|join only

@@ -1,0 +1,122 @@
+"""In-process native resize, cluster level (slow tier, ISSUE 12): a
+real 4-process native-engine world goes 4 -> 3 -> 4 — one rank evicts
+itself, the survivors absorb the shrink with ``rabit.resize("recover")``
+and keep streaming exact collectives at world 3, then the SAME evicted
+process re-admits itself with ``rabit.resize("join")`` — and no worker
+process ever exits: ``total_attempts == 0`` (a resize used to cost a
+respawn out of the ``max_attempts`` budget on the native engine), and
+the post-resize collectives are bit-identical to a fixed-world baseline
+(doc/fault_tolerance.md "Elastic membership")."""
+
+import os
+import re
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "native", "build", "librabit_tpu_core.so")
+WORKERS = os.path.join(ROOT, "tests", "workers")
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not os.path.isfile(LIB),
+                       reason="native core not built"),
+]
+
+sys.path.insert(0, ROOT)
+
+N = 4
+
+
+def _run(out_dir, env_extra):
+    from rabit_tpu.tracker.launch import launch
+    cmd = [sys.executable, os.path.join(WORKERS, "resize_worker.py")]
+    stats = {}
+    old = {}
+    env = {"RESIZE_OUT": out_dir, "KILL_TASK": "1"}
+    env.update(env_extra)
+    for k, v in env.items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        rc = launch(N, cmd, max_attempts=3, timeout=120, stats=stats,
+                    elastic=True)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rc, stats
+
+
+def _rounds(out_dir, rank, tag):
+    with open(os.path.join(out_dir, f"r{rank}.log")) as f:
+        lines = f.read().splitlines()
+    out = []
+    for ln in lines:
+        m = re.match(rf"{tag} round=(\d+) world=(\d+) "
+                     r"crc=([0-9a-f]{8})$", ln)
+        if m:
+            out.append((int(m.group(1)), int(m.group(2)), m.group(3)))
+    return lines, out
+
+
+def test_native_world_survives_shrink_grow_in_process(tmp_path):
+    base = str(tmp_path / "base")
+    rsz = str(tmp_path / "resize")
+    os.makedirs(base)
+    os.makedirs(rsz)
+
+    # fixed-world baseline: same pre/post rounds, no resize
+    rc, stats = _run(base, {})
+    assert rc == 0
+    assert stats["total_attempts"] == 0, stats
+
+    # resize run: 4 -> 3 -> 4 entirely in-process
+    rc, stats = _run(rsz, {"RESIZE_ENABLE": "1"})
+    assert rc == 0
+
+    # the headline: nothing respawned and nothing was re-admitted BY
+    # THE LAUNCHER — the shrink and the grow never cost a process exit
+    # or a slot of any rank's max_attempts budget
+    assert stats["total_attempts"] == 0, stats
+    assert stats["readmissions"] == 0, stats
+    doc = stats["membership"]
+    assert doc["world"] == N and doc["elastic"], doc
+    assert doc["evicted"] == [] and doc["joining"] == [], doc
+    assert doc["epoch"] == 3, doc         # formed -> shrunk -> regrown
+
+    for r in range(N):
+        lines, pre = _rounds(rsz, r, "pre")
+        _, post = _rounds(rsz, r, "post")
+        _, pre_b = _rounds(base, r, "pre")
+        _, post_b = _rounds(base, r, "post")
+        # every rank ran every pre and post round at the full world
+        assert [(n, w) for n, w, _ in pre] == \
+            [(n, N) for n in range(0, 5)], (r, lines)
+        assert [(n, w) for n, w, _ in post] == \
+            [(n, N) for n in range(10, 15)], (r, lines)
+        # post-resize collectives bit-exact vs the fixed-world baseline
+        assert pre == pre_b, f"rank {r} pre stream diverged"
+        assert post == post_b, f"rank {r} post stream diverged"
+        assert "done" in lines, (r, lines)
+
+    # the three survivors streamed exact MID rounds at world N-1
+    mids = 0
+    for r in range(N):
+        _, mid = _rounds(rsz, r, "mid")
+        if mid:
+            assert [(n, w) for n, w, _ in mid] == \
+                [(n, N - 1) for n in range(5, 8)], (r, mid)
+            mids += 1
+    assert mids == N - 1, "every survivor must stream the shrunk world"
+
+    # the victim's process never exited: same process evicted itself,
+    # waited out the shrink, and rejoined the grown world
+    with open(os.path.join(rsz, "r1.log")) as f:
+        victim = f.read().splitlines()
+    assert any("evicted self (process alive)" in ln for ln in victim)
+    assert any(re.match(r"rejoined rank=\d+ world=4$", ln)
+               for ln in victim), victim

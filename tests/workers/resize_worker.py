@@ -1,0 +1,137 @@
+"""In-process native-resize cluster worker
+(tests/test_native_resize_cluster.py, ISSUE 12).
+
+A native-engine rank that survives an elastic shrink -> grow WITHOUT
+its process ever exiting — the point of ``rabit.resize()``: before
+this PR a world resize on the native engine meant dying and burning a
+``max_attempts`` respawn; now it is an in-process relink.
+
+Phases (rounds are a pure function of (round, world), so int64 sums
+are exact and CRC streams are bit-comparable across runs):
+
+- pre: all ranks form world N and stream ``PRE`` exact rounds;
+- shrink (resize runs only): the victim reports ITSELF evicted over
+  the ``evict`` wire command — its process stays alive — and the
+  survivors absorb the shrink with ``rabit.resize("recover")``,
+  streaming ``MID`` rounds at world N-1 while the victim waits;
+- grow: the victim re-admits itself with ``rabit.resize("join")``
+  (parked at the tracker until the epoch boundary; the survivors see
+  the parked joiner and resize once more), and all N ranks stream
+  ``POST`` rounds — which must be bit-identical to a fixed-world
+  baseline that never resized.
+
+Exit 0 only if every round on every path was exact.
+"""
+
+import json
+import os
+import socket
+import struct
+import sys
+import time
+import zlib
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+from rabit_tpu.tracker import membership  # noqa: E402
+from rabit_tpu.tracker.tracker import MAGIC  # noqa: E402
+
+HOST = os.environ["RABIT_TRACKER_URI"]
+PORT = int(os.environ["RABIT_TRACKER_PORT"])
+TASK = os.environ.get("RABIT_TASK_ID", "?")
+OUT = os.environ["RESIZE_OUT"]
+KILL_TASK = os.environ.get("KILL_TASK", "1")
+DO_RESIZE = os.environ.get("RESIZE_ENABLE", "") == "1"
+DEADLINE = time.monotonic() + float(os.environ.get("RESIZE_DEADLINE", "90"))
+
+PRE = range(0, 5)      # world N
+MID = range(5, 8)      # world N-1 (survivors only)
+POST = range(10, 15)   # world N again — compared against the baseline
+
+
+def log(msg):
+    with open(os.path.join(OUT, f"r{TASK}.log"), "a") as f:
+        f.write(msg + "\n")
+
+
+def do_round(tag, rnd):
+    world, rank = rabit.get_world_size(), rabit.get_rank()
+    a = np.arange(256, dtype=np.int64) * (rank + 1) + rnd
+    out = rabit.allreduce(a, rabit.SUM)
+    expect = (np.arange(256, dtype=np.int64)
+              * (world * (world + 1) // 2) + rnd * world)
+    np.testing.assert_array_equal(out, expect)
+    log(f"{tag} round={rnd} world={world} "
+        f"crc={zlib.crc32(out.tobytes()):08x}")
+
+
+def evict_self(rank):
+    """First-party death evidence for THIS rank — but the process
+    stays alive, which is exactly what makes the later ``join`` an
+    in-process re-admission instead of a respawn."""
+    c = socket.create_connection((HOST, PORT), timeout=10)
+    for chunk in (struct.pack("<I", MAGIC),):
+        c.sendall(chunk)
+    for s in ("evict", TASK):
+        b = s.encode()
+        c.sendall(struct.pack("<I", len(b)) + b)
+    c.sendall(struct.pack("<I", 0))
+    payload = json.dumps({"rank": rank, "reason": "resize-test"}).encode()
+    c.sendall(struct.pack("<I", len(payload)) + payload)
+    ok = struct.unpack("<I", c.recv(4))[0]
+    c.close()
+    return ok
+
+
+def wait_for(pred, what):
+    while True:
+        assert time.monotonic() < DEADLINE, f"timed out waiting for {what}"
+        doc = membership.fetch_world(HOST, PORT, TASK)
+        if doc is not None and pred(doc):
+            return doc
+        time.sleep(0.05)
+
+
+def main():
+    rabit.init([a for a in sys.argv[1:] if "=" in a], engine="native")
+    rank, world = rabit.get_rank(), rabit.get_world_size()
+    assert rabit.is_distributed()
+    log(f"formed rank={rank} world={world}")
+
+    for rnd in PRE:
+        do_round("pre", rnd)
+
+    if DO_RESIZE:
+        if TASK == KILL_TASK:
+            assert evict_self(rabit.get_rank()) == 1
+            log("evicted self (process alive)")
+            # survivors must absorb the shrink before we park, or the
+            # next batch forms straight back at the target world
+            wait_for(lambda d: d.get("epoch", 0) >= 2, "shrunk world")
+            rabit.resize("join")
+            log(f"rejoined rank={rabit.get_rank()} "
+                f"world={rabit.get_world_size()}")
+        else:
+            wait_for(lambda d: d.get("evicted"), "eviction")
+            rabit.resize("recover")
+            log(f"reformed rank={rabit.get_rank()} "
+                f"world={rabit.get_world_size()}")
+            for rnd in MID:
+                do_round("mid", rnd)
+            wait_for(lambda d: d.get("joining"), "parked joiner")
+            rabit.resize("recover")
+            log(f"reformed rank={rabit.get_rank()} "
+                f"world={rabit.get_world_size()}")
+
+    for rnd in POST:
+        do_round("post", rnd)
+
+    log("done")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -143,7 +143,12 @@ _R002_HOOK = "epoch_reset"
 # membership transition) without a self._wal(...) call in the same
 # function means a resumed tracker forgets that state.
 R003_FILE = os.path.join("rabit_tpu", "tracker", "tracker.py")
-R003_STATE = {"_ranks", "_topo", "_skew", "_endpoints", "_epoch"}
+R003_STATE = {"_ranks", "_topo", "_skew", "_endpoints", "_epoch",
+              # leadership lease (ISSUE 12): the lease IS a journaled
+              # record — a lease mutation that skips the WAL is a
+              # leadership claim replication can never ship, i.e. a
+              # structural split-brain hole
+              "_lease"}
 _R003_MEMBER_MUTATORS = {"evict", "park", "formed"}
 _R003_EXEMPT_PREFIXES = ("_replay",)
 
