@@ -296,9 +296,20 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
     def spawn(i: int) -> None:
         env = dict(os.environ)
         env.update(tracker.env(task_id=str(i), num_attempt=attempts[i]))
-        # chaos: workers rendezvous through the tracker-front proxy
-        env["RABIT_TRACKER_URI"] = tracker_addr[0]
-        env["RABIT_TRACKER_PORT"] = str(tracker_addr[1])
+        # rendezvous at the CURRENT control plane, read at spawn time:
+        # the chaos front proxy when one is configured (retarget()
+        # keeps it valid across a failover), else the supervisor's
+        # live tracker. The launch-time address must not be baked in —
+        # after a failover the deposed leader is fenced and nothing
+        # ever listens there again, so a worker respawned later would
+        # burn its whole attempts budget connecting to a dead address.
+        if farm is not None:
+            uri, tracker_port = tracker_addr
+        else:
+            live = sup.tracker
+            uri, tracker_port = live.host, live.port
+        env["RABIT_TRACKER_URI"] = uri
+        env["RABIT_TRACKER_PORT"] = str(tracker_port)
         if standby is not None:
             # the pre-advertised failover address: worker-side breakers
             # probe it when the leader goes quiet (telemetry/skew.py)

@@ -13,22 +13,31 @@ that journal into a full :class:`~rabit_tpu.tracker.tracker.Tracker` on
 the pre-advertised failover address.
 
 Why split-brain is structurally impossible: leadership is a *record in
-the replicated log*, not a lock in memory. The leader journals a lease
-renewal every ``lease_ms/3``; renewals replicate in the same total
-order as every other transition; and the follower's promotion gate is
-"the newest lease I hold durably has expired". At most one unexpired
-lease can exist anywhere, so there is never a moment where two trackers
-both believe they own the world.
+the replicated stream*, not a lock in memory. The leader journals its
+lease CLAIM (replicated in the same total order as every other
+transition) and then heartbeats a renewal every ``lease_ms/3`` —
+idempotent renewals ride the stream as ephemeral seq-0 frames so the
+journal stays bounded (tracker.py ``_wal``). The follower's promotion
+gate is "a full lease of *silence* from the leader, measured on MY
+monotonic clock": every frame received restarts a local
+``time.monotonic`` countdown of one lease, and promotion requires the
+countdown to lapse with the stream down. Deliberately NOT "the
+leader-stamped ``until_ms`` passed my wall clock": across hosts that
+comparison is hostage to NTP — a clock step larger than the renewal
+margin could promote under a live leader, or hold a dead leader's
+lease alive forever. Monotonic clocks never step, so the gate needs no
+clock agreement between machines, and a standby promotes only when the
+leader has provably been unable to reach it for a full lease.
 
 Failure model (doc/fault_tolerance.md "Hot standby & failover"):
 
 - leader crash: the repl stream tears (EOF), reconnects are refused,
-  the lease lapses within ``lease_ms`` of the last renewal, and the
-  standby promotes — failover is bounded by the lease, not by the
-  supervisor's respawn schedule;
-- leader partition: renewals stop arriving (the stream stalls rather
+  the local countdown lapses within ``lease_ms`` of the last received
+  frame, and the standby promotes — failover is bounded by the lease,
+  not by the supervisor's respawn schedule;
+- leader partition: frames stop arriving (the stream stalls rather
   than tears); the follower's read timeout fires after a full lease of
-  silence and the same expiry gate promotes it;
+  silence and the same countdown gate promotes it;
 - double failure (standby also dead): the supervisor falls back to the
   PR 10 path — cold respawn with ``--resume`` on the pinned port.
 
@@ -112,6 +121,12 @@ class StandbyTracker:
         self._wal = _wal_mod.WriteAheadLog(self.wal_dir)
         self._wal.open(resume=False)
         self._lease: Optional[dict] = None
+        # the promotion gate: a LOCAL monotonic deadline one lease out
+        # from the last frame the leader managed to deliver. Restarted
+        # on every received frame (any frame is proof of life), never
+        # compared against the leader-stamped until_ms — wall clocks
+        # on two hosts need not agree, monotonic silence does.
+        self._lease_deadline: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.tracker: Optional[_tracker_mod.Tracker] = None
@@ -178,6 +193,30 @@ class StandbyTracker:
             conn.close()
             raise
 
+    def _restart_countdown(self, lease: Optional[dict] = None) -> None:
+        """A frame arrived: the leader is alive and could reach us, so
+        the promotion countdown restarts — one full lease of LOCAL
+        monotonic time (a lease record's own width wins over ours, so
+        both sides always count the same lease)."""
+        ms = self.lease_ms
+        if isinstance(lease, dict):
+            try:
+                ms = max(100, int(lease.get("lease_ms", ms)))
+            except (TypeError, ValueError):
+                pass
+        self._lease_deadline = time.monotonic() + ms / 1e3
+
+    def _may_promote(self) -> bool:
+        """True once a full lease of silence elapsed on the local
+        monotonic clock since the last frame — with the stream already
+        down (the caller only asks between subscriptions). Never
+        compares the leader-stamped ``until_ms`` against our wall
+        clock: cross-host skew must not be able to promote under a
+        live leader (see the module docstring)."""
+        return (self._lease is not None
+                and self._lease_deadline is not None
+                and time.monotonic() >= self._lease_deadline)
+
     def _follow_loop(self) -> None:
         backoff = 0.05
         while not self._stop.is_set():
@@ -192,10 +231,18 @@ class StandbyTracker:
                         frame = _wal_mod.recv_frame(conn)
                         if frame is None:
                             raise ConnectionError("leader closed stream")
+                        seq, kind, data = _wal_mod.decode_record(frame)
+                        lease = data if kind == _wal_mod.LEASE_KIND \
+                            else None
+                        self._restart_countdown(lease)
+                        if lease is not None:
+                            self._lease = lease
+                        if seq == 0:
+                            # ephemeral lease heartbeat: proof of life
+                            # and a fresher doc, never journaled or
+                            # acked on either side
+                            continue
                         seq = self._wal.append_encoded(frame)
-                        _, kind, data = _wal_mod.decode_record(frame)
-                        if kind == _wal_mod.LEASE_KIND:
-                            self._lease = data
                         conn.sendall(struct.pack("<I", seq))
                         self.acked_seq = seq
                 except (OSError, ConnectionError, struct.error,
@@ -212,8 +259,7 @@ class StandbyTracker:
                         pass
             if self._stop.is_set():
                 return
-            if _wal_mod.lease_expired(self._lease) \
-                    and self._lease is not None:
+            if self._may_promote():
                 self._promote()
                 return
             if self._lease is None and conn is None:
@@ -225,17 +271,20 @@ class StandbyTracker:
 
     # -- promotion --------------------------------------------------------
     def _promote(self) -> None:
-        """The lease lapsed and the leader is unreachable: replay the
-        replicated journal into a real Tracker on the advertised
-        failover address. The promoted tracker renews the lease under
-        its OWN node id from here on — it is the leader now."""
+        """A full lease of silence and the leader is unreachable:
+        replay the replicated journal into a real Tracker on the
+        advertised failover address. The promoted tracker claims the
+        lease under its OWN node id from here on — it is the leader
+        now."""
         self._wal.close()
         try:
             self._placeholder.close()
         except OSError:
             pass
-        self._log(f"lease expired ({self._lease}); promoting on "
-                  f"{self.host}:{self.port} from seq {self._wal.seq}")
+        self._log(f"no leader frame for a full lease "
+                  f"({self.lease_ms}ms, last lease {self._lease}); "
+                  f"promoting on {self.host}:{self.port} from seq "
+                  f"{self._wal.seq}")
         deadline = time.monotonic() + 10
         while True:
             if self._stop.is_set():

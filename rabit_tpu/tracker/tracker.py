@@ -42,7 +42,14 @@ Wire protocol (binary, little-endian, length-prefixed strings):
                    journals — waiting for a u32 seq ack (bounded by
                    rabit_repl_ack_timeout_ms) after each before sending
                    the next. A torn stream resyncs by resubscribing
-                   from the follower's last durable seq.
+                   from the follower's last durable seq. Interleaved
+                   with the journaled records the leader also ships
+                   ephemeral ``seq 0`` lease-heartbeat frames (same
+                   framing, never journaled on either side, never
+                   acked): idempotent lease renewals ride these so the
+                   journal stays bounded by real transitions while the
+                   follower's promotion countdown still restarts every
+                   ``lease_ms/3``.
     skew:          (no extra fields) tracker -> worker: payload str, a
                    JSON {"epoch","offsets_ms","laggard"} fleet skew
                    digest — the tracker-side FleetElection's smoothed,
@@ -341,6 +348,15 @@ class Tracker:
         self._repl_cv = threading.Condition()
         self._repl_log: List[bytes] = []    # frame i carries seq i+1
         self._repl_subs: List[dict] = []
+        # newest ephemeral lease heartbeat (a seq-0 frame) + a counter
+        # so each subscriber can tell "a fresher one arrived"; only the
+        # newest matters, so heartbeats are a slot, not a log
+        self._repl_hb: Optional[bytes] = None
+        self._repl_hb_n = 0
+        # the lease doc last actually journaled (vs merely heartbeat):
+        # a renewal that matches it except for until_ms is idempotent
+        # and stays out of the journal entirely
+        self._journaled_lease: Optional[dict] = None
         if wal_dir is not None:
             self._wal_log = _wal_mod.WriteAheadLog(wal_dir)
             records = self._wal_log.open(resume=resume)
@@ -390,6 +406,7 @@ class Tracker:
                 self.restarts = int(data.get("restarts", self.restarts))
             elif kind == _wal_mod.LEASE_KIND:
                 self._lease = dict(data)
+                self._journaled_lease = dict(data)
 
     def _wal(self, kind: str, **data) -> None:
         """Journal one control-plane transition (no-op when the WAL is
@@ -399,12 +416,37 @@ class Tracker:
         record is also published to ``repl`` subscribers as the exact
         frame bytes that hit the disk (re-encoding is byte-identical:
         canonical JSON)."""
-        if self._wal_log is not None:
-            seq = self._wal_log.record(kind, **data)
-            frame = _wal_mod.encode_record(seq, kind, data)
-            with self._repl_cv:
-                self._repl_log.append(frame)
+        if self._wal_log is None:
+            return
+        with self._repl_cv:
+            if kind == _wal_mod.LEASE_KIND and \
+                    _wal_mod.lease_renewal_only(self._journaled_lease,
+                                                data):
+                # idempotent renewal (same owner, same width, only
+                # until_ms advanced): keep it OUT of the journal — at
+                # one beat per lease_ms/3 a multi-day job would grow
+                # the WAL, this replication log, and every future
+                # replay without bound — and ship it to subscribers as
+                # an ephemeral seq-0 heartbeat frame instead. The
+                # follower restarts its promotion countdown on receipt
+                # and never journals or acks it.
+                self._repl_hb = _wal_mod.encode_record(0, kind, data)
+                self._repl_hb_n += 1
                 self._repl_cv.notify_all()
+                return
+            # seq assignment and positional publication must be ONE
+            # atomic step: journal writers run concurrently (the lease
+            # thread beats while connection handlers journal endpoint/
+            # join/shutdown transitions), so recording outside this
+            # lock would let seq N+1 land in _repl_log before seq N —
+            # permanently misindexing the stream ``_serve_repl`` reads
+            # positionally. record() takes only the WAL's own
+            # leaf-level lock, so nesting it here cannot deadlock.
+            seq = self._wal_log.record(kind, **data)
+            if kind == _wal_mod.LEASE_KIND:
+                self._journaled_lease = dict(data)
+            self._repl_log.append(_wal_mod.encode_record(seq, kind, data))
+            self._repl_cv.notify_all()
 
     def _note_resume(self, nrecords: int) -> None:
         """Make a tracker resume observable: span + counter + flight
@@ -434,12 +476,15 @@ class Tracker:
 
     # -- leadership lease + WAL replication (ISSUE 12) --------------------
     def _renew_lease(self) -> None:
-        """Journal a fresh leadership lease. The lease is a RECORD in
-        the replicated log, not a lock in memory: renewals stream to
-        the standby like every transition, and the standby may only
-        promote after the newest lease it holds expired — so at most
-        one unexpired lease exists anywhere (split-brain is
-        structurally impossible)."""
+        """Renew the leadership lease. The CLAIM (first lease, or an
+        owner change) is a journaled record in the replicated log;
+        renewals that merely advance ``until_ms`` are idempotent and
+        ride the stream as ephemeral heartbeats (``_wal`` compacts
+        them), so the journal stays bounded by real transitions. The
+        standby may only promote after a full lease of silence from
+        this stream — its countdown is LOCAL monotonic time restarted
+        on every received frame, so the gate needs no clock agreement
+        between hosts."""
         lease = _wal_mod.lease_doc(self.node_id, self.lease_ms)
         self._wal(_wal_mod.LEASE_KIND, **lease)
         with self._lock:
@@ -491,16 +536,29 @@ class Tracker:
         sub = {"peer": peer, "acked": last}
         with self._repl_cv:
             self._repl_subs.append(sub)
+            hb_seen = self._repl_hb_n
         try:
             next_seq = last + 1
             while not self._done.is_set():
+                hb = None
                 with self._repl_cv:
                     while (len(self._repl_log) < next_seq
+                           and self._repl_hb_n <= hb_seen
                            and not self._done.is_set()):
                         self._repl_cv.wait(0.2)
                     if self._done.is_set():
                         break
-                    frame = self._repl_log[next_seq - 1]
+                    if len(self._repl_log) >= next_seq:
+                        frame = self._repl_log[next_seq - 1]
+                    else:
+                        hb = self._repl_hb
+                        hb_seen = self._repl_hb_n
+                if hb is not None:
+                    # ephemeral lease heartbeat (seq 0): fire and
+                    # forget — the follower restarts its promotion
+                    # countdown on receipt, never journals or acks it
+                    conn.sendall(hb)
+                    continue
                 conn.sendall(frame)
                 ack = _recv_u32(conn)
                 if ack != next_seq:

@@ -163,12 +163,26 @@ def recv_frame(sock) -> Optional[bytes]:
 
 # --------------------------------------------------------------- leases
 #
-# Leadership is a lease RECORD in the journal, not a lock in memory: the
-# leader renews by journaling {"owner", "until_ms"} (which replicates to
-# the follower like every other transition), and the follower may only
-# promote itself once the last lease it holds has expired. Because every
-# lease lives in the same totally-ordered replicated log, at most one
-# unexpired lease can exist — split-brain is structurally impossible.
+# Leadership is a lease RECORD in the replicated stream, not a lock in
+# memory: the leader journals its CLAIM ({"owner", "until_ms"}; the
+# first lease, or an owner change) and then renews every lease_ms/3.
+# Renewals are idempotent — only the newest matters — so the tracker
+# compacts them out of the journal and ships them to followers as
+# ephemeral seq-0 heartbeat frames instead (tracker.py ``_wal``): the
+# WAL, the in-memory replication log, and every future replay stay
+# bounded by real transitions, not by heartbeat cadence x job duration.
+#
+# The follower's promotion gate deliberately does NOT compare the
+# leader-stamped ``until_ms`` against its own wall clock: across hosts
+# that would make the split-brain guarantee hostage to NTP (a clock
+# step larger than the renewal margin could promote under a live
+# leader, or pin a dead one's lease alive forever). Instead the
+# follower restarts a LOCAL ``time.monotonic`` countdown of one full
+# lease on every frame it receives from the leader (standby.py), and
+# promotes only when that countdown lapses with the stream down — the
+# gate needs no clock agreement between machines. ``lease_expired``
+# below stays wall-clock and is for same-clock consumers only (the
+# leader inspecting its own lease, tools, tests).
 
 LEASE_KIND = "lease"
 
@@ -185,8 +199,12 @@ def lease_doc(owner: str, lease_ms: int,
 
 def lease_expired(lease: Optional[Dict[str, Any]],
                   now_ms: Optional[int] = None) -> bool:
-    """True when ``lease`` no longer holds leadership. A missing or
-    malformed lease is expired (no one holds the world)."""
+    """True when ``lease`` no longer holds leadership *by the caller's
+    clock*. A missing or malformed lease is expired (no one holds the
+    world). Same-clock consumers only: ``until_ms`` was stamped by the
+    lease's OWNER, so comparing it against another host's wall clock
+    inherits their skew — the standby's promotion gate uses its local
+    monotonic countdown instead (see the module comment above)."""
     if now_ms is None:
         now_ms = int(time.time() * 1000)
     if not isinstance(lease, dict):
@@ -195,6 +213,18 @@ def lease_expired(lease: Optional[Dict[str, Any]],
         return int(lease.get("until_ms", 0)) <= int(now_ms)
     except (TypeError, ValueError):
         return True
+
+
+def lease_renewal_only(prev: Optional[Dict[str, Any]],
+                       new: Dict[str, Any]) -> bool:
+    """True when ``new`` merely advances ``prev``'s expiry: the same
+    owner at the same width, only ``until_ms`` moved. Such renewals
+    are idempotent and stay out of the journal (the claim is the
+    record; renewals are stream heartbeats — see the module comment)."""
+    if not isinstance(prev, dict):
+        return False
+    return (new.get("owner") == prev.get("owner")
+            and new.get("lease_ms") == prev.get("lease_ms"))
 
 
 def last_lease(records: List[Tuple[str, dict]]

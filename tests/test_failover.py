@@ -10,6 +10,7 @@ import os
 import socket
 import struct
 import sys
+import threading
 import time
 
 import pytest
@@ -114,18 +115,35 @@ def test_last_lease_picks_newest():
     assert wal_mod.last_lease([]) is None
 
 
-def test_leader_journals_and_renews_lease(tmp_path):
+def test_lease_renewal_only():
+    a = wal_mod.lease_doc("x", 1000, now_ms=1)
+    b = wal_mod.lease_doc("x", 1000, now_ms=2)
+    assert wal_mod.lease_renewal_only(a, b)       # only until_ms moved
+    assert not wal_mod.lease_renewal_only(None, b)      # first claim
+    assert not wal_mod.lease_renewal_only(
+        a, wal_mod.lease_doc("y", 1000, now_ms=2))      # owner change
+    assert not wal_mod.lease_renewal_only(
+        a, wal_mod.lease_doc("x", 2000, now_ms=2))      # width change
+
+
+def test_leader_claims_lease_once_then_renews_in_memory(tmp_path):
     tr = Tracker(2, wal_dir=str(tmp_path), lease_ms=SHORT).start()
     try:
         first = tr.lease()
         assert first is not None and first["owner"] == "leader"
         _wait(lambda: tr.lease()["until_ms"] > first["until_ms"],
               msg="lease never renewed")
+        seq = tr.repl_stats()["seq"]
     finally:
         tr.stop()
     replayed = wal_mod.WriteAheadLog(str(tmp_path)).replay()
     leases = [d for k, d in replayed if k == wal_mod.LEASE_KIND]
-    assert len(leases) >= 2                       # initial + a renewal
+    # renewals are idempotent and compacted to stream heartbeats: the
+    # journal holds the CLAIM alone, so a multi-day job's WAL, the
+    # in-memory replication log, and every future replay stay bounded
+    # by real transitions rather than heartbeat cadence
+    assert len(leases) == 1
+    assert seq == len(replayed) == 1
     assert wal_mod.last_lease(replayed)["owner"] == "leader"
 
 
@@ -215,6 +233,57 @@ def test_repl_torn_stream_resyncs_from_last_seq(tmp_path):
         tr.stop()
 
 
+def test_repl_stream_heartbeats_renewals_without_journal(tmp_path):
+    """Lease renewals reach subscribers as ephemeral seq-0 frames:
+    fresher until_ms on the wire, no ack wanted, journal unchanged."""
+    tr = Tracker(2, wal_dir=str(tmp_path), lease_ms=SHORT).start()
+    try:
+        c = _subscribe(tr, 0)
+        # the journaled claim arrives as real record 1 and wants an ack
+        seq, kind, claim = wal_mod.decode_record(wal_mod.recv_frame(c))
+        assert (seq, kind) == (1, wal_mod.LEASE_KIND)
+        _send_u32(c, seq)
+        # renewals then stream as heartbeats (the renewal thread beats
+        # every lease_ms/3); two in a row prove they keep flowing and
+        # that no ack is expected between them
+        beats = [wal_mod.decode_record(wal_mod.recv_frame(c))
+                 for _ in range(2)]
+        for hseq, hkind, hdoc in beats:
+            assert (hseq, hkind) == (0, wal_mod.LEASE_KIND)
+            assert hdoc["owner"] == claim["owner"]
+            assert hdoc["until_ms"] > claim["until_ms"]
+        c.close()
+        # ...and the journal did not grow by a single record
+        assert tr.repl_stats()["seq"] == 1
+    finally:
+        tr.stop()
+
+
+def test_wal_publication_order_under_concurrent_writers(tmp_path):
+    """Seq assignment and _repl_log publication are one atomic step:
+    concurrent journal writers (the lease thread vs connection-handler
+    threads) must never misindex the positional stream — a single
+    swapped pair would poison every follower resync forever."""
+    tr = Tracker(2, wal_dir=str(tmp_path))
+    try:
+        def hammer(t):
+            for j in range(100):
+                tr._wal("endpoint", task=f"{t}-{j}",
+                        doc={"host": "h", "port": j, "rank": t})
+        workers = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(8)]
+        for w in workers:
+            w.start()
+        for w in workers:
+            w.join()
+        assert len(tr._repl_log) == 800
+        for i, frame in enumerate(tr._repl_log):
+            seq, _, _ = wal_mod.decode_record(frame)
+            assert seq == i + 1, f"frame at index {i} carries seq {seq}"
+    finally:
+        tr.stop()
+
+
 def test_repl_wrong_ack_drops_subscriber(tmp_path):
     tr = Tracker(2, wal_dir=str(tmp_path)).start()
     try:
@@ -271,6 +340,53 @@ def test_standby_resyncs_but_holds_while_lease_live(tmp_path):
         _wait(lambda: sb.resyncs >= 1, msg="torn stream never resynced")
         assert not sb.promoted()                  # lease still live
         assert sb.alive()
+    finally:
+        sb.stop()
+        tr.stop()
+
+
+def test_heartbeats_hold_standby_through_idle(tmp_path):
+    """A live but IDLE leader (no journaled traffic at all) must hold
+    its standby through stream heartbeats alone — several full leases
+    of idle may not promote."""
+    tr = Tracker(2, wal_dir=str(tmp_path / "leader"),
+                 lease_ms=SHORT).start()
+    sb = StandbyTracker(tr.host, tr.port, 2,
+                        wal_dir=str(tmp_path / "standby"),
+                        lease_ms=SHORT, quiet=True).start()
+    try:
+        _wait(lambda: sb._lease is not None)
+        time.sleep(3 * SHORT / 1e3)
+        assert not sb.promoted() and sb.alive()
+    finally:
+        sb.stop()
+        tr.stop()
+
+
+def test_promotion_immune_to_leader_clock_skew(tmp_path, monkeypatch):
+    """The promotion gate is a standby-LOCAL monotonic countdown, so a
+    leader whose wall clock is hours ahead (NTP step, cross-host skew)
+    cannot pin its lease alive past its death: failover stays bounded
+    by one lease of real time, not by the skewed until_ms."""
+    real = wal_mod.lease_doc
+
+    def skewed(owner, lease_ms, now_ms=None):
+        return real(owner, lease_ms,
+                    now_ms=int(time.time() * 1000) + 3_600_000)
+
+    monkeypatch.setattr(wal_mod, "lease_doc", skewed)
+    tr = Tracker(2, wal_dir=str(tmp_path / "leader"),
+                 lease_ms=SHORT).start()
+    sb = StandbyTracker(tr.host, tr.port, 2,
+                        wal_dir=str(tmp_path / "standby"),
+                        lease_ms=SHORT, quiet=True).start()
+    try:
+        _wait(lambda: sb._lease is not None)
+        assert sb._lease["until_ms"] > int(time.time() * 1000) + SHORT
+        tr.crash()
+        _wait(lambda: sb.promoted(),
+              msg="skewed until_ms deferred promotion past the lease")
+        assert sb.tracker.promoted
     finally:
         sb.stop()
         tr.stop()
