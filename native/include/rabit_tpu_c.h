@@ -99,6 +99,24 @@ int RbtInitAfterException(void);
  * while checkpoints and the version counter survive. */
 int RbtResize(const char* cmd);
 
+/* Out-of-band interrupt (self-healing ladder, reform rung): ask the
+ * collective currently blocked in the engine to bail out into the
+ * robust layer's global re-formation instead of spinning on a wedged
+ * link. Safe to call from any thread (the watchdog monitor); a no-op
+ * when nothing consumes it. */
+int RbtInterrupt(void);
+
+/* Recovery provenance counters (monotonic since Init): in-collective
+ * round retries, CRC-rejected frames, and in-place link resurrections.
+ * NULL out-pointers are skipped. */
+int RbtRecoveryStats(uint64_t* retries, uint64_t* frame_rejects,
+                     uint64_t* resurrects);
+
+/* CRC-32 (IEEE/zlib polynomial) of buf — the checksum used by the
+ * framed data plane (rabit_frame_crc); exposed so bindings/tests can
+ * cross-check frames against zlib.crc32 without a second impl. */
+uint32_t RbtFrameCrc32(const void* buf, uint64_t len);
+
 /* last error message for bindings (empty string if none) */
 const char* RbtGetLastError(void);
 

@@ -262,6 +262,25 @@ int RbtVersionNumber(void) {
   }
 }
 
+int RbtInterrupt(void) {
+  // no RT_API_BEGIN: just an atomic flag raise, and it must stay
+  // safe from the watchdog monitor thread while the engine thread is
+  // blocked inside a collective
+  rt::RequestInterrupt();
+  return 0;
+}
+
+int RbtRecoveryStats(uint64_t* retries, uint64_t* frame_rejects,
+                     uint64_t* resurrects) {
+  RT_API_BEGIN();
+  GetComm()->GetRecoveryStats(retries, frame_rejects, resurrects);
+  RT_API_END();
+}
+
+uint32_t RbtFrameCrc32(const void* buf, uint64_t len) {
+  return rt::Crc32(buf, static_cast<size_t>(len));
+}
+
 // no-op link anchor (reference RabitLinkTag, c_api.h:156-164)
 int RbtLinkTag(void) { return 0; }
 

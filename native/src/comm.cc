@@ -50,6 +50,13 @@ void Comm::SetupFromConfig(const Config& cfg) {
       cfg.GetBool("rabit_stop_process_on_error", false) ||
       // DMLC_WORKER_STOP_PROCESS_ON_ERROR normalizes to this key
       cfg.GetBool("rabit_worker_stop_process_on_error", false);
+  // self-healing data plane (doc/fault_tolerance.md): CRC-framed
+  // payload hops with hop-local retransmission + in-place link
+  // resurrection. Off by default — with the knob unset the wire format
+  // and code paths are byte-identical to the unframed engine.
+  frame_crc_ = cfg.GetBool("rabit_frame_crc", false);
+  frame_retries_ = static_cast<int>(cfg.GetInt("rabit_frame_retries", 4));
+  resurrect_ms_ = static_cast<int>(cfg.GetInt("rabit_resurrect_ms", 5000));
   host_ = GetHostName();
 }
 
@@ -205,11 +212,16 @@ void Comm::ReconnectLinks(const char* cmd) {
 
   uint32_t nconnect = t.RecvU32();
   std::map<int, TcpConn> conns;
+  // resurrection metadata: how each connect-side link was dialed, so a
+  // mid-collective conn death can be repaired in place (ResurrectLink)
+  struct PeerAddr { std::string host; int port; std::string token; };
+  std::map<int, PeerAddr> peer_addr;
   for (uint32_t i = 0; i < nconnect; ++i) {
     int peer = static_cast<int>(t.RecvU32());
     std::string phost = t.RecvStr();
     int pport = static_cast<int>(t.RecvU32());
     std::string ptoken = t.RecvStr();
+    peer_addr[peer] = PeerAddr{phost, pport, ptoken};
     // Same-host peers skip the loopback TCP stack via the peer
     // listener's abstract-UDS twin. The twin's name is a random
     // tracker-relayed token, so resolving it in this netns IS the
@@ -289,6 +301,14 @@ void Comm::ReconnectLinks(const char* cmd) {
     l.peer_rank = kv.first;
     l.conn = std::move(kv.second);
     l.conn.SetKeepAlive();
+    auto pa = peer_addr.find(kv.first);
+    if (pa != peer_addr.end()) {
+      // we dialed this peer; a dead conn is repaired by redialing
+      l.i_connect = true;
+      l.peer_host = pa->second.host;
+      l.peer_port = pa->second.port;
+      l.peer_token = pa->second.token;
+    }
     links_.push_back(std::move(l));
   }
   auto find_link = [&](int r) {
@@ -397,6 +417,8 @@ NetResult Comm::TryAllreduce(void* buf, size_t elem_size, size_t count,
 // sent up (same invariant as the reference's single-buffer design).
 NetResult Comm::TryAllreduceTree(char* buf, size_t elem_size, size_t count,
                                  ReduceFn reducer) {
+  if (frame_crc_) return TryAllreduceTreeFramed(buf, elem_size, count,
+                                                reducer);
   const size_t total = elem_size * count;
   std::vector<int> children;
   int parent_link = -1;
@@ -433,6 +455,7 @@ NetResult Comm::TryAllreduceTree(char* buf, size_t elem_size, size_t count,
     };
 
     while (!done()) {
+      if (TakeInterrupt()) return NetResult::kInterrupt;
       Poller poll;
       bool watching = false;
       for (size_t c = 0; c < children.size(); ++c) {
@@ -456,7 +479,9 @@ NetResult Comm::TryAllreduceTree(char* buf, size_t elem_size, size_t count,
         }
       }
       if (watching) {
-        if (poll.Wait(-1) < 0) return NetResult::kError;
+        // bounded wait so an out-of-band interrupt (watchdog reform
+        // rung) is observed within ~500ms even on a fully wedged link
+        if (poll.Wait(500) < 0) return NetResult::kError;
       }
       NetResult res;
       // children -> us (reduce direction)
@@ -521,6 +546,15 @@ NetResult Comm::TryAllreduceTree(char* buf, size_t elem_size, size_t count,
 // they arrive (reference TryBroadcast, allreduce_base.cc:649-737).
 NetResult Comm::TryBroadcast(char* buf, size_t size, int root) {
   if (world_ == 1 || size == 0) return NetResult::kOk;
+  if (frame_crc_) {
+    // framed broadcast = framed routed multicast with need=everyone.
+    // The dynamic in-link discovery below is incompatible with
+    // stop-and-wait framing (the first frame would be consumed before
+    // the in-link is known), so the framed path uses the static
+    // binary-tree plan every rank derives identically.
+    std::vector<uint8_t> need(world_, 1);
+    return TryRouteDataFramed(buf, size, root, need);
+  }
   const bool is_root = (rank_ == root);
   int in_link = is_root ? -2 : -1;  // -2: we originate; -1: unknown yet
   size_t recvd = is_root ? size : 0;
@@ -536,6 +570,7 @@ NetResult Comm::TryBroadcast(char* buf, size_t size, int root) {
   };
 
   while (!done()) {
+    if (TakeInterrupt()) return NetResult::kInterrupt;
     Poller poll;
     for (size_t i = 0; i < tree_idx_.size(); ++i) {
       auto& conn = links_[tree_idx_[i]].conn;
@@ -545,7 +580,7 @@ NetResult Comm::TryBroadcast(char* buf, size_t size, int root) {
       if (static_cast<int>(i) != in_link && sent[i] < recvd)
         poll.WatchWrite(conn.fd());
     }
-    if (poll.Wait(-1) < 0) return NetResult::kError;
+    if (poll.Wait(500) < 0) return NetResult::kError;
     NetResult res;
     if (in_link == -1) {
       for (size_t i = 0; i < tree_idx_.size(); ++i) {
@@ -590,6 +625,7 @@ NetResult Comm::TryBroadcast(char* buf, size_t size, int root) {
 NetResult Comm::TryRouteData(char* buf, size_t size, int src_rank,
                              const std::vector<uint8_t>& need) {
   if (world_ == 1 || size == 0) return NetResult::kOk;
+  if (frame_crc_) return TryRouteDataFramed(buf, size, src_rank, need);
   const int P = world_;
   bool any = false;
   for (int r = 0; r < P; ++r) any = any || (need[r] != 0);
@@ -652,12 +688,13 @@ NetResult Comm::TryRouteData(char* buf, size_t size, int src_rank,
     return true;
   };
   while (!done()) {
+    if (TakeInterrupt()) return NetResult::kInterrupt;
     Poller poll;
     if (in_link >= 0 && recvd < size)
       poll.WatchRead(links_[in_link].conn.fd());
     for (size_t i = 0; i < out_links.size(); ++i)
       if (sent[i] < recvd) poll.WatchWrite(links_[out_links[i]].conn.fd());
-    if (poll.Wait(-1) < 0) return NetResult::kError;
+    if (poll.Wait(500) < 0) return NetResult::kError;
     NetResult res;
     if (in_link >= 0 && recvd < size &&
         poll.CanRead(links_[in_link].conn.fd())) {
@@ -690,14 +727,17 @@ std::vector<size_t> Comm::RingRanges(size_t count, size_t elem_size) const {
 
 NetResult Comm::RingExchange(const char* send_buf, size_t send_n,
                              char* recv_buf, size_t recv_n) {
+  if (frame_crc_) return FramedRingExchange(send_buf, send_n,
+                                            recv_buf, recv_n);
   auto& next = links_[ring_next_].conn;
   auto& prev = links_[ring_prev_].conn;
   size_t sent = 0, recvd = 0;
   while (sent < send_n || recvd < recv_n) {
+    if (TakeInterrupt()) return NetResult::kInterrupt;
     Poller poll;
     if (sent < send_n) poll.WatchWrite(next.fd());
     if (recvd < recv_n) poll.WatchRead(prev.fd());
-    if (poll.Wait(-1) < 0) return NetResult::kError;
+    if (poll.Wait(500) < 0) return NetResult::kError;
     NetResult res;
     if (sent < send_n && poll.CanWrite(next.fd())) {
       ssize_t k = next.TrySend(send_buf + sent, send_n - sent, &res);
@@ -761,6 +801,484 @@ NetResult Comm::TryAllreduceRing(char* buf, size_t elem_size, size_t count,
   NetResult res = TryReduceScatterRing(buf, elem_size, count, reducer);
   if (res != NetResult::kOk) return res;
   return TryAllgatherRing(buf, elem_size, count);
+}
+
+// ---------------------------------------------------------------------------
+// Framed data plane (rabit_frame_crc=1): every payload hop becomes a
+// stop-and-wait [magic|seq|len|crc]+payload frame answered by an
+// ACK/NAK verdict. A corrupt frame is rejected and retransmitted
+// hop-local — corrupt bytes are never folded into the reduction or
+// forwarded downstream. A conn death mid-frame is repaired in place
+// (ResurrectLink): the fresh connection carries a seq handshake that
+// resolves whether the in-flight frame was delivered, so a repaired
+// link neither loses nor double-applies a frame. Remaining holes are
+// deliberately bounded, not closed: a bit flip landing in a frame
+// HEADER (16 bytes vs kFrameChunk of payload) can desync the byte
+// stream, and a corrupted verdict can strand a retransmission — both
+// exhaust frame_retries_ (or trip a parse check) and surface as
+// kReset, which the robust layer's existing global recovery
+// (ReconnectLinks + replay) already handles.
+// ---------------------------------------------------------------------------
+
+static const uint32_t kFrameMagic = 0x52425446;    // "RBTF"
+static const uint32_t kVerdictMagic = 0x52425456;  // "RBTV"
+static const uint32_t kVerdictAck = 1;
+static const uint32_t kVerdictNak = 0;
+// compile-time frame payload cap: both ends derive identical chunking
+// from sizes they already agree on, so no config-skew can desync it
+static const size_t kFrameChunk = 1u << 20;
+
+struct FrameHeader {
+  uint32_t magic, seq, len, crc;
+};
+struct VerdictMsg {
+  uint32_t magic, seq, code;
+};
+
+NetResult Comm::FramedStep(int out_li, const char* sbuf, size_t sn,
+                           int in_li, char* rbuf, size_t rn) {
+  bool send_done = (out_li < 0);
+  bool recv_done = (in_li < 0);
+  if (send_done && recv_done) return NetResult::kOk;
+  int snaks = 0, rnaks = 0;
+
+  // per-link IO state; out_li == in_li (2-rank ring) shares one stream
+  struct LinkIO {
+    std::vector<char> out;   // complete messages, appended in order
+    size_t out_off = 0;
+    enum State { kMagicSt, kFrameSt, kVerdictSt, kPayloadSt } st = kMagicSt;
+    char hdr[sizeof(FrameHeader)];
+    size_t hdr_got = 0;
+    FrameHeader fh{};
+    std::vector<char> payload;
+    size_t pay_got = 0;
+    void ResetParse() { st = kMagicSt; hdr_got = 0; pay_got = 0; }
+  };
+  std::vector<int> ls;
+  if (out_li >= 0) ls.push_back(out_li);
+  if (in_li >= 0 && in_li != out_li) ls.push_back(in_li);
+  std::vector<LinkIO> io(ls.size());
+  auto io_of = [&](int li) -> LinkIO& {
+    return io[(ls.size() == 2 && li == ls[1]) ? 1 : 0];
+  };
+
+  auto enqueue_frame = [&]() {
+    LinkIO& o = io_of(out_li);
+    FrameHeader h{kFrameMagic, links_[out_li].send_seq,
+                  static_cast<uint32_t>(sn), Crc32(sbuf, sn)};
+    const char* hp = reinterpret_cast<const char*>(&h);
+    o.out.insert(o.out.end(), hp, hp + sizeof(h));
+    o.out.insert(o.out.end(), sbuf, sbuf + sn);
+  };
+  auto enqueue_verdict = [&](int li, uint32_t seq, uint32_t code) {
+    LinkIO& o = io_of(li);
+    VerdictMsg v{kVerdictMagic, seq, code};
+    const char* vp = reinterpret_cast<const char*>(&v);
+    o.out.insert(o.out.end(), vp, vp + sizeof(v));
+  };
+  if (!send_done) enqueue_frame();
+
+  // conn death: repair in place, then recompute direction doneness from
+  // the seqs exchanged in the resurrection handshake — the fresh stream
+  // starts clean, so no partial frame/verdict bytes survive
+  auto repair = [&](int li) -> bool {
+    if (!ResurrectLink(li)) return false;
+    LinkIO& o = io_of(li);
+    o.out.clear();
+    o.out_off = 0;
+    o.ResetParse();
+    if (li == out_li && !send_done) {
+      Link& l = links_[out_li];
+      if (l.peer_recv_seq > l.send_seq) {
+        ++l.send_seq;  // in-flight frame was already accepted
+        send_done = true;
+      } else {
+        enqueue_frame();
+      }
+    }
+    // recv side: if we had accepted (recv_seq advanced pre-ack) the
+    // peer learned it from the handshake; otherwise it resends
+    return true;
+  };
+
+  // a completed inbound frame on in_li
+  auto handle_frame = [&](int li, const FrameHeader& fh,
+                          const char* pay) -> NetResult {
+    if (li != in_li) return NetResult::kReset;  // frame on a verdict link
+    Link& l = links_[li];
+    if (fh.seq < l.recv_seq) {  // dup (our earlier ack was lost): re-ack
+      enqueue_verdict(li, fh.seq, kVerdictAck);
+      return NetResult::kOk;
+    }
+    if (fh.seq != l.recv_seq || recv_done) return NetResult::kReset;
+    if (Crc32(pay, fh.len) != fh.crc) {
+      ++stat_frame_rejects_;
+      enqueue_verdict(li, l.recv_seq, kVerdictNak);
+      return ++rnaks > frame_retries_ ? NetResult::kReset : NetResult::kOk;
+    }
+    if (fh.len != rn) return NetResult::kReset;  // plan skew: not healable
+    memcpy(rbuf, pay, rn);
+    ++l.recv_seq;  // advance BEFORE acking: the resurrection handshake
+                   // then proves delivery even when the ack is lost
+    recv_done = true;
+    enqueue_verdict(li, fh.seq, kVerdictAck);
+    return NetResult::kOk;
+  };
+
+  auto handle_verdict = [&](int li, const VerdictMsg& v) -> NetResult {
+    if (li != out_li) return NetResult::kReset;
+    if (send_done) return NetResult::kOk;  // stale: already confirmed
+    if (v.code == kVerdictAck && v.seq == links_[li].send_seq) {
+      ++links_[li].send_seq;
+      send_done = true;
+      return NetResult::kOk;
+    }
+    // NAK — or a verdict whose fields the fault corrupted: retransmit
+    // either way; a re-sent frame the peer actually accepted is just a
+    // dup it re-acks, so over-retransmitting converges
+    if (++snaks > frame_retries_) return NetResult::kReset;
+    enqueue_frame();
+    return NetResult::kOk;
+  };
+
+  auto all_done = [&]() {
+    if (!send_done || !recv_done) return false;
+    for (auto& o : io)
+      if (o.out_off < o.out.size()) return false;
+    return true;
+  };
+
+  while (!all_done()) {
+    if (TakeInterrupt()) return NetResult::kInterrupt;
+    Poller poll;
+    for (size_t x = 0; x < ls.size(); ++x) {
+      if (io[x].out_off < io[x].out.size())
+        poll.WatchWrite(links_[ls[x]].conn.fd());
+      bool want_read = (ls[x] == in_li && !recv_done) ||
+                       (ls[x] == out_li && !send_done);
+      if (want_read) poll.WatchRead(links_[ls[x]].conn.fd());
+    }
+    if (poll.Wait(500) < 0) return NetResult::kError;
+    for (size_t x = 0; x < ls.size(); ++x) {
+      int li = ls[x];
+      LinkIO& o = io[x];
+      NetResult res;
+      if (o.out_off < o.out.size() &&
+          poll.CanWrite(links_[li].conn.fd())) {
+        ssize_t k = links_[li].conn.TrySend(o.out.data() + o.out_off,
+                                            o.out.size() - o.out_off, &res);
+        if (k < 0) {
+          if (res == NetResult::kError) return res;
+          if (!repair(li)) return NetResult::kReset;
+          continue;  // fresh conn, stale poll results: re-poll
+        }
+        o.out_off += static_cast<size_t>(k);
+        if (o.out_off == o.out.size()) {
+          o.out.clear();
+          o.out_off = 0;
+        }
+      }
+      bool want_read = (li == in_li && !recv_done) ||
+                       (li == out_li && !send_done);
+      if (!want_read || !poll.CanRead(links_[li].conn.fd())) continue;
+      // pump available bytes through the message parser
+      for (bool progress = true; progress;) {
+        progress = false;
+        size_t need = 0;
+        char* dst = nullptr;
+        switch (o.st) {
+          case LinkIO::kMagicSt: need = 4; dst = o.hdr; break;
+          case LinkIO::kFrameSt: need = sizeof(FrameHeader); dst = o.hdr;
+            break;
+          case LinkIO::kVerdictSt: need = sizeof(VerdictMsg); dst = o.hdr;
+            break;
+          case LinkIO::kPayloadSt:
+            need = o.fh.len;
+            dst = o.payload.data();
+            break;
+        }
+        size_t* got = (o.st == LinkIO::kPayloadSt) ? &o.pay_got : &o.hdr_got;
+        if (*got < need) {
+          ssize_t k = links_[li].conn.TryRecv(dst + *got, need - *got, &res);
+          if (k < 0) {
+            if (res == NetResult::kError) return res;
+            if (!repair(li)) return NetResult::kReset;
+            break;
+          }
+          if (k == 0) break;  // kAgain: kernel buffer drained
+          *got += static_cast<size_t>(k);
+          progress = true;
+        }
+        if (*got < need) continue;
+        // a complete unit: advance the parser state machine
+        switch (o.st) {
+          case LinkIO::kMagicSt: {
+            uint32_t magic = 0;
+            memcpy(&magic, o.hdr, 4);
+            if (magic == kFrameMagic) o.st = LinkIO::kFrameSt;
+            else if (magic == kVerdictMagic) o.st = LinkIO::kVerdictSt;
+            else return NetResult::kReset;  // stream desync
+            progress = true;
+            break;
+          }
+          case LinkIO::kFrameSt: {
+            memcpy(&o.fh, o.hdr, sizeof(o.fh));
+            if (o.fh.len > kFrameChunk) return NetResult::kReset;
+            o.payload.resize(o.fh.len);
+            o.pay_got = 0;
+            o.st = LinkIO::kPayloadSt;
+            progress = true;
+            break;
+          }
+          case LinkIO::kPayloadSt: {
+            NetResult r = handle_frame(li, o.fh, o.payload.data());
+            if (r != NetResult::kOk) return r;
+            o.ResetParse();
+            progress = true;
+            break;
+          }
+          case LinkIO::kVerdictSt: {
+            VerdictMsg v{};
+            memcpy(&v, o.hdr, sizeof(v));
+            NetResult r = handle_verdict(li, v);
+            if (r != NetResult::kOk) return r;
+            o.ResetParse();
+            progress = true;
+            break;
+          }
+        }
+        // stop reading the moment this link owes us nothing more —
+        // bytes of the NEXT collective's frames stay in the kernel
+        bool still = (li == in_li && !recv_done) ||
+                     (li == out_li && !send_done);
+        if (!still) break;
+      }
+    }
+  }
+  return NetResult::kOk;
+}
+
+NetResult Comm::FramedSendLink(int li, const char* buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    size_t k = std::min(kFrameChunk, n - off);
+    NetResult r = FramedStep(li, buf + off, k, -1, nullptr, 0);
+    if (r != NetResult::kOk) return r;
+    off += k;
+  }
+  return NetResult::kOk;
+}
+
+NetResult Comm::FramedRecvLink(int li, char* buf, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    size_t k = std::min(kFrameChunk, n - off);
+    NetResult r = FramedStep(-1, nullptr, 0, li, buf + off, k);
+    if (r != NetResult::kOk) return r;
+    off += k;
+  }
+  return NetResult::kOk;
+}
+
+// duplex frame pipeline with the ring neighbors: one frame each way per
+// step until both directions are exhausted. Chunk sizes on each side
+// are derived from the range sizes the ring algorithm already agrees
+// on, so sender and receiver compute identical frame sequences.
+NetResult Comm::FramedRingExchange(const char* send_buf, size_t send_n,
+                                   char* recv_buf, size_t recv_n) {
+  size_t soff = 0, roff = 0;
+  while (soff < send_n || roff < recv_n) {
+    int out_li = soff < send_n ? ring_next_ : -1;
+    int in_li = roff < recv_n ? ring_prev_ : -1;
+    size_t sk = out_li >= 0 ? std::min(kFrameChunk, send_n - soff) : 0;
+    size_t rk = in_li >= 0 ? std::min(kFrameChunk, recv_n - roff) : 0;
+    NetResult r = FramedStep(out_li, send_buf + soff, sk,
+                             in_li, recv_buf + roff, rk);
+    if (r != NetResult::kOk) return r;
+    soff += sk;
+    roff += rk;
+  }
+  return NetResult::kOk;
+}
+
+// In-place repair of one dead link. The side that originally dialed
+// redials (UDS token first, then TCP, linear backoff) while the side
+// that originally accepted re-accepts on its persistent listener; both
+// re-run the rank handshake, then exchange recv_seq so the frame layer
+// can tell whether its in-flight frame was delivered. All blocking
+// reads are bounded — a half-open peer costs at most the redial
+// budget, after which the caller escalates to full ReconnectLinks.
+bool Comm::ResurrectLink(int li) {
+  Link& l = links_[li];
+  l.conn.Close();
+  const double deadline = GetTime() + resurrect_ms_ / 1000.0;
+  TcpConn c;
+  if (l.i_connect) {
+    for (int attempt = 0; GetTime() < deadline; ++attempt) {
+      c = TcpConn();
+      if (cfg_.GetBool("rabit_local_uds", true) && !l.peer_token.empty())
+        c = TcpConn::ConnectLocal(l.peer_token);
+      if (!c.ok()) {
+        try {
+          c = TcpConn::Connect(l.peer_host, l.peer_port, /*retries=*/0);
+        } catch (const Error&) {
+          c = TcpConn();
+        }
+      }
+      if (c.ok() && LinkHandshake(&c, rank_, l.peer_rank) == Handshake::kOk)
+        break;
+      c.Close();
+      int ms = std::min(100 * (attempt + 1), 1000);
+      std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+    }
+    if (!c.ok()) return false;
+  } else {
+    for (;;) {
+      int remain = static_cast<int>((deadline - GetTime()) * 1000.0);
+      if (remain <= 0) return false;
+      TcpConn a = listener_.AcceptTimeout(std::min(remain, 500));
+      if (!a.ok()) continue;  // timeout slice; loop re-checks the budget
+      uint32_t magic = 0, prank = 0;
+      if (!a.RecvAllTimeout(&magic, 4, 2000) || magic != kLinkMagic)
+        continue;  // stray connect: drop without consuming the budget
+      if (!a.RecvAllTimeout(&prank, 4, 2000) ||
+          static_cast<int>(prank) != l.peer_rank)
+        continue;
+      try {
+        a.SendU32(static_cast<uint32_t>(rank_));
+      } catch (const Error&) {
+        continue;
+      }
+      c = std::move(a);
+      break;
+    }
+  }
+  // both-send-first is safe on a fresh stream: 4 bytes fit any socket
+  // buffer, so neither side can block the other's send
+  try {
+    c.SendU32(l.recv_seq);
+  } catch (const Error&) {
+    return false;
+  }
+  uint32_t peer_recv = 0;
+  if (!c.RecvAllTimeout(&peer_recv, 4, resurrect_ms_)) return false;
+  l.peer_recv_seq = peer_recv;
+  c.SetKeepAlive();
+  c.SetNonBlocking(true);
+  l.conn = std::move(c);
+  ++stat_link_resurrects_;
+  if (debug_) {
+    LogInfo(StrFormat("rank %d resurrected link to rank %d", rank_,
+                      l.peer_rank));
+  }
+  return true;
+}
+
+// Framed tree allreduce: stop-and-wait per segment — receive each
+// child's segment whole (verified), fold, pass up, receive the result,
+// fan down. Unlike the streaming variant, segment size must be derived
+// only from values every rank shares (elem_size + the compile-time
+// chunk), never from the local child count — receiver and sender must
+// compute identical frame sequences.
+NetResult Comm::TryAllreduceTreeFramed(char* buf, size_t elem_size,
+                                       size_t count, ReduceFn reducer) {
+  const size_t total = elem_size * count;
+  std::vector<int> children;
+  int parent_link = -1;
+  for (size_t i = 0; i < tree_idx_.size(); ++i) {
+    if (static_cast<int>(i) == parent_pos_) parent_link = tree_idx_[i];
+    else children.push_back(tree_idx_[i]);
+  }
+  const size_t seg_max =
+      std::max<size_t>(kFrameChunk / elem_size, 1) * elem_size;
+  std::vector<char> cbuf(std::min<size_t>(seg_max, total));
+  for (size_t seg_off = 0; seg_off < total; seg_off += seg_max) {
+    const size_t S = std::min(seg_max, total - seg_off);
+    char* base = buf + seg_off;
+    for (int c : children) {
+      NetResult r = FramedRecvLink(c, cbuf.data(), S);
+      if (r != NetResult::kOk) return r;
+      reducer(base, cbuf.data(), S / elem_size);
+    }
+    if (parent_link >= 0) {
+      NetResult r = FramedSendLink(parent_link, base, S);
+      if (r != NetResult::kOk) return r;
+      r = FramedRecvLink(parent_link, base, S);
+      if (r != NetResult::kOk) return r;
+    }
+    for (int c : children) {
+      NetResult r = FramedSendLink(c, base, S);
+      if (r != NetResult::kOk) return r;
+    }
+  }
+  return NetResult::kOk;
+}
+
+// Framed targeted multicast: same deterministic binary-tree plan as
+// TryRouteData, with chunk-level store-and-forward (receive a verified
+// frame, then relay it) instead of byte streaming — a corrupt chunk is
+// stopped at the first hop, never propagated down the routing subtree.
+NetResult Comm::TryRouteDataFramed(char* buf, size_t size, int src_rank,
+                                   const std::vector<uint8_t>& need) {
+  if (world_ == 1 || size == 0) return NetResult::kOk;
+  const int P = world_;
+  bool any = false;
+  for (int r = 0; r < P; ++r) any = any || (need[r] != 0);
+  if (!any) return NetResult::kOk;
+  std::vector<int> toward(P, -1), order;
+  std::vector<uint8_t> seen(P, 0), sub(P, 0);
+  order.reserve(P);
+  order.push_back(src_rank);
+  seen[src_rank] = 1;
+  for (size_t i = 0; i < order.size(); ++i) {
+    int u = order[i];
+    int nb[3] = {u > 0 ? (u - 1) / 2 : -1, 2 * u + 1, 2 * u + 2};
+    for (int v : nb) {
+      if (v < 0 || v >= P || seen[v]) continue;
+      seen[v] = 1;
+      toward[v] = u;
+      order.push_back(v);
+    }
+  }
+  for (size_t i = order.size(); i-- > 0;) {
+    int u = order[i];
+    if (need[u]) sub[u] = 1;
+    if (sub[u] && toward[u] >= 0) sub[toward[u]] = 1;
+  }
+  const bool is_src = (rank_ == src_rank);
+  if (!is_src && !sub[rank_]) return NetResult::kOk;
+  auto link_of = [&](int peer) {
+    for (size_t i = 0; i < links_.size(); ++i)
+      if (links_[i].peer_rank == peer) return static_cast<int>(i);
+    Fail(StrFormat("route peer %d not among links", peer));
+    return -1;
+  };
+  int in_link = is_src ? -1 : link_of(toward[rank_]);
+  std::vector<int> out_links;
+  int my_nb[3] = {rank_ > 0 ? (rank_ - 1) / 2 : -1, 2 * rank_ + 1,
+                  2 * rank_ + 2};
+  for (int v : my_nb) {
+    if (v < 0 || v >= P || toward[v] != rank_) continue;
+    if (sub[v]) out_links.push_back(link_of(v));
+  }
+  std::vector<char> scratch;
+  char* data = buf;
+  if (!is_src && !need[rank_]) {
+    scratch.resize(size);
+    data = scratch.data();
+  }
+  for (size_t off = 0; off < size; off += kFrameChunk) {
+    size_t k = std::min(kFrameChunk, size - off);
+    if (in_link >= 0) {
+      NetResult r = FramedRecvLink(in_link, data + off, k);
+      if (r != NetResult::kOk) return r;
+    }
+    for (int ol : out_links) {
+      NetResult r = FramedSendLink(ol, data + off, k);
+      if (r != NetResult::kOk) return r;
+    }
+  }
+  return NetResult::kOk;
 }
 
 // ---------------------------------------------------------------------------

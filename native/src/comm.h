@@ -113,10 +113,32 @@ class Comm {
   virtual void LazyCheckpoint(const std::string* global);
   int version_number() const { return version_; }
 
+  // Recovery provenance counters (self-healing data plane): drained by
+  // the Python engine after each collective into telemetry rows.
+  void GetRecoveryStats(uint64_t* retries, uint64_t* frame_rejects,
+                        uint64_t* resurrects) const {
+    if (retries) *retries = stat_retries_;
+    if (frame_rejects) *frame_rejects = stat_frame_rejects_;
+    if (resurrects) *resurrects = stat_link_resurrects_;
+  }
+
  protected:
   struct Link {
     TcpConn conn;
     int peer_rank = -1;
+    // Resurrection metadata: how this link was originally wired, so a
+    // mid-collective conn death can be repaired in place (connector
+    // re-dials, acceptor re-accepts) without tearing the whole world
+    // down through ReconnectLinks.
+    std::string peer_host;
+    std::string peer_token;   // UDS fast-path token, may be empty
+    int peer_port = 0;
+    bool i_connect = false;   // true: this side dialed; false: accepted
+    // Framed-mode stop-and-wait sequence state, per direction. seqs
+    // reset naturally on ReconnectLinks (fresh Link structs all ranks).
+    uint32_t send_seq = 0;    // next frame seq to send
+    uint32_t recv_seq = 0;    // next frame seq expected
+    uint32_t peer_recv_seq = 0;  // peer's recv_seq learned at resurrection
   };
 
   // --- bootstrap -------------------------------------------------------
@@ -162,6 +184,33 @@ class Comm {
   NetResult RingExchange(const char* send_buf, size_t send_n,
                          char* recv_buf, size_t recv_n);
 
+  // --- framed data plane (rabit_frame_crc=1) ---------------------------
+  // CRC-framed stop-and-wait variants of the streaming collectives: every
+  // payload hop is a [magic|seq|len|crc] frame answered by an ACK/NAK
+  // verdict, so a corrupt frame is rejected and retransmitted hop-local
+  // — never accumulated into the reduction. Off by default; with the
+  // knob unset none of this code runs and the wire is byte-identical.
+  // One duplex frame round on up to two links: send a frame out out_li
+  // (if >= 0) while receiving one from in_li (if >= 0), then exchange
+  // verdicts; retransmits CRC-rejected directions up to frame_retries_.
+  NetResult FramedStep(int out_li, const char* sbuf, size_t sn,
+                       int in_li, char* rbuf, size_t rn);
+  NetResult FramedSendLink(int li, const char* buf, size_t n);
+  NetResult FramedRecvLink(int li, char* buf, size_t n);
+  NetResult FramedRingExchange(const char* send_buf, size_t send_n,
+                               char* recv_buf, size_t recv_n);
+  NetResult TryAllreduceTreeFramed(char* buf, size_t elem_size,
+                                   size_t count, ReduceFn reducer);
+  NetResult TryRouteDataFramed(char* buf, size_t size, int src_rank,
+                               const std::vector<uint8_t>& need);
+  // In-place repair of one dead link: connector re-dials (UDS token
+  // first, then TCP, bounded backoff within resurrect_ms_), acceptor
+  // re-accepts with the same budget; both re-handshake rank identity
+  // and exchange recv_seq so an in-flight frame is not double-applied.
+  // Returns false when the budget is exhausted — caller escalates to
+  // the full ReconnectLinks ladder via kReset.
+  bool ResurrectLink(int li);
+
   // --- state -----------------------------------------------------------
   Config cfg_;
   int rank_ = 0;
@@ -200,6 +249,14 @@ class Comm {
   uint32_t world_epoch_ = 0;
   std::string coord_host_;
   int coord_port_ = 0;
+
+  // self-healing data plane knobs + provenance counters
+  bool frame_crc_ = false;      // rabit_frame_crc: CRC-framed payloads
+  int frame_retries_ = 4;       // rabit_frame_retries: per-hop re-rounds
+  int resurrect_ms_ = 5000;     // rabit_resurrect_ms: redial budget
+  uint64_t stat_retries_ = 0;          // robust-layer round re-executions
+  uint64_t stat_frame_rejects_ = 0;    // CRC-rejected frames (hop-local)
+  uint64_t stat_link_resurrects_ = 0;  // links repaired in place
 
   Listener listener_;
   // One socket per distinct neighbor (tree parent/children and ring

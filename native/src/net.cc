@@ -13,12 +13,47 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <random>
 #include <thread>
 
 #include "log.h"
 
 namespace rt {
+
+// CRC-32, IEEE/zlib polynomial (0xEDB88320 reflected), table-driven.
+// Table built once, thread-safe via C++11 static-init guarantees.
+static const uint32_t* Crc32Table() {
+  static uint32_t table[256];
+  static const bool init = [] {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    return true;
+  }();
+  (void)init;
+  return table;
+}
+
+uint32_t Crc32(const void* data, size_t n) {
+  const uint32_t* table = Crc32Table();
+  const unsigned char* p = static_cast<const unsigned char*>(data);
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i)
+    c = table[(c ^ p[i]) & 0xFFu] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// Interrupt flag is process-global: the watchdog's monitor thread has
+// no engine handle, and the engine's thread-local comm slot would hide
+// a flag set from another thread anyway.
+static std::atomic<bool> g_interrupt{false};
+
+void RequestInterrupt() { g_interrupt.store(true); }
+bool TakeInterrupt() { return g_interrupt.exchange(false); }
 
 TcpConn& TcpConn::operator=(TcpConn&& o) noexcept {
   if (this != &o) {
@@ -94,6 +129,25 @@ void TcpConn::SetNoDelay() {
 void TcpConn::SetKeepAlive() {
   int one = 1;
   setsockopt(fd_, SOL_SOCKET, SO_KEEPALIVE, &one, sizeof(one));
+}
+
+bool TcpConn::RecvAllTimeout(void* data, size_t n, int timeout_ms) {
+  char* p = static_cast<char*>(data);
+  while (n > 0) {
+    pollfd pfd{fd_, POLLIN, 0};
+    int rc = ::poll(&pfd, 1, timeout_ms);
+    if (rc < 0 && errno == EINTR) continue;
+    if (rc <= 0) return false;  // timeout or poll error
+    ssize_t k = ::recv(fd_, p, n, 0);
+    if (k < 0) {
+      if (errno == EINTR || errno == EAGAIN || errno == EWOULDBLOCK) continue;
+      return false;
+    }
+    if (k == 0) return false;  // peer closed mid-handshake
+    p += k;
+    n -= static_cast<size_t>(k);
+  }
+  return true;
 }
 
 void TcpConn::SendAll(const void* data, size_t n) {
@@ -292,6 +346,29 @@ TcpConn Listener::Accept() {
     }
     if (errno == EINTR || errno == ECONNABORTED) continue;
     Fail(StrFormat("accept failed: %s", strerror(errno)));
+  }
+}
+
+TcpConn Listener::AcceptTimeout(int timeout_ms) {
+  for (;;) {
+    pollfd pfds[2] = {{fd_, POLLIN, 0}, {ufd_, POLLIN, 0}};
+    int npfd = ufd_ < 0 ? 1 : 2;
+    int rc = ::poll(pfds, npfd, timeout_ms);
+    if (rc < 0) {
+      if (errno == EINTR) continue;
+      return TcpConn();
+    }
+    if (rc == 0) return TcpConn();  // timeout: caller escalates
+    // UDS first, mirroring Accept(): prefer the fast path on a race
+    int lfd = (npfd == 2 && (pfds[1].revents & POLLIN)) ? ufd_ : fd_;
+    int fd = ::accept(lfd, nullptr, nullptr);
+    if (fd >= 0) {
+      TcpConn c(fd);
+      c.SetNoDelay();
+      return c;
+    }
+    if (errno == EINTR || errno == ECONNABORTED) continue;
+    return TcpConn();
   }
 }
 

@@ -19,7 +19,23 @@ namespace rt {
 // Outcome of a socket operation; the recovery layer keys off kReset
 // (peer death) vs kError (local/socket failure) — reference
 // ReturnType {kSuccess,kConnReset,kRecvZeroLen,kSockError}.
-enum class NetResult { kOk, kAgain, kReset, kError };
+// kInterrupt is not a socket outcome: poll loops synthesize it when an
+// out-of-band interrupt (RequestInterrupt) asks the collective to bail
+// out to the recovery layer, which treats it like kReset.
+enum class NetResult { kOk, kAgain, kReset, kError, kInterrupt };
+
+// CRC-32 (IEEE/zlib polynomial, bit-reflected) over ``n`` bytes —
+// matches Python's zlib.crc32 so frames checked here can be
+// cross-checked by the test battery without a second implementation.
+uint32_t Crc32(const void* data, size_t n);
+
+// Out-of-band interrupt plane: a watchdog (any thread) raises the
+// flag; collective poll loops observe it and return kInterrupt so the
+// robust layer can run its global-reset recovery instead of spinning
+// on a wedged link. File-scope (NOT per-comm/thread) on purpose — the
+// raiser is a monitor thread that holds no engine handle.
+void RequestInterrupt();
+bool TakeInterrupt();   // consume-and-clear; false when no request
 
 class TcpConn {
  public:
@@ -52,6 +68,11 @@ class TcpConn {
   void SetNonBlocking(bool on);
   void SetNoDelay();
   void SetKeepAlive();
+  // Bounded blocking receive: like RecvAll but gives up (returning
+  // false, conn state unspecified) when no progress happens within
+  // ``timeout_ms``. Used only during the link-resurrection handshake
+  // so a half-open redial cannot wedge a rank forever.
+  bool RecvAllTimeout(void* data, size_t n, int timeout_ms);
 
   // Blocking full-buffer ops (bootstrap/tracker path).
   void SendAll(const void* data, size_t n);
@@ -84,6 +105,11 @@ class Listener {
   // measurement and an escape hatch)
   void Bind(int port_start, int ntrial = 1000, bool with_local = true);
   TcpConn Accept();   // whichever family is ready first
+  // Accept bounded by ``timeout_ms``; returns an invalid conn
+  // (ok() == false) on timeout. Link resurrection uses this so the
+  // accepting side of a dead link waits only its redial budget before
+  // escalating to the full ReconnectLinks ladder.
+  TcpConn AcceptTimeout(int timeout_ms);
   int port() const { return port_; }
   // Random per-listener name of the UDS twin ("" when disabled or
   // bind failed). Workers advertise it through the tracker; peers that

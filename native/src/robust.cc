@@ -48,6 +48,9 @@ void RobustComm::Init(int argc, const char* const* argv) {
   result_round_ = (num_global_replica_ > 0)
       ? static_cast<uint32_t>(std::max(1, world_ / num_global_replica_))
       : 1;  // <=0: keep every result on every rank
+  collective_retries_ = static_cast<int>(
+      cfg_.GetInt("rabit_collective_retries", 1000));
+  if (collective_retries_ < 1) collective_retries_ = 1;
 }
 
 void RobustComm::Resize(const char* cmd) {
@@ -136,18 +139,24 @@ NetResult RobustComm::AgreeNeed(bool mine, std::vector<uint8_t>* need,
 void RobustComm::ConsensusAllreduce(void* buf, size_t elem_size, size_t count,
                                     ReduceFn fn) {
   std::string pristine(static_cast<char*>(buf), elem_size * count);
-  for (int attempt = 0; attempt < 1000; ++attempt) {
+  for (int attempt = 0; attempt < collective_retries_; ++attempt) {
     NetResult res = TryAllreduce(buf, elem_size, count, fn);
     if (res == NetResult::kOk) return;
     memcpy(buf, pristine.data(), pristine.size());
     CheckAndRecover(res);
   }
-  Fail("consensus allreduce failed after 1000 recovery attempts");
+  Fail(StrFormat("consensus allreduce failed after %d recovery attempts",
+                 collective_retries_));
 }
 
+// Every in-collective recovery — link reset, frame-retry exhaustion, or
+// an out-of-band interrupt (NetResult::kInterrupt from the watchdog's
+// reform rung) — converges here: peers blocked in Try* observe the conn
+// teardown as kReset and realign in the same global re-formation.
 void RobustComm::CheckAndRecover(NetResult res) {
   (void)res;
   ++recover_counter_;
+  ++stat_retries_;  // provenance counter, drained by the Python engine
   if (debug_) {
     LogInfo(StrFormat("rank %d entering recovery #%d", rank_,
                       recover_counter_));
@@ -486,10 +495,11 @@ void RobustComm::Allreduce(void* buf, size_t elem_size, size_t count,
     // bounded, not infinite: a persistent misconfiguration (e.g. a data
     // plane that can never form its device world) must fail loudly
     // instead of spinning through reconnect cycles forever
-    RT_CHECK(attempt < 1000,
-             "allreduce failed after 1000 recovery attempts — persistent "
-             "failure, not a transient death (check data-plane/coordinator "
-             "configuration)");
+    RT_CHECK(attempt < collective_retries_,
+             StrFormat("allreduce failed after %d recovery attempts — "
+                       "persistent failure, not a transient death (check "
+                       "data-plane/coordinator configuration)",
+                       collective_retries_));
     // execute step: accelerator data plane when eligible, socket
     // tree/ring otherwise — the robust wrapper structure of the
     // reference (allreduce_robust.cc:159-219 around TryAllreduce)
@@ -545,9 +555,10 @@ void RobustComm::Broadcast(void* buf, size_t size, int root,
   double t0 = debug_ ? GetTime() : 0.0;
   std::string pristine(static_cast<char*>(buf), size);
   for (int attempt = 0;; ++attempt) {
-    RT_CHECK(attempt < 1000,
-             "broadcast failed after 1000 recovery attempts — persistent "
-             "failure, not a transient death");
+    RT_CHECK(attempt < collective_retries_,
+             StrFormat("broadcast failed after %d recovery attempts — "
+                       "persistent failure, not a transient death",
+                       collective_retries_));
     NetResult res = TryBroadcast(static_cast<char*>(buf), size, root);
     if (res == NetResult::kOk) {
       if (debug_) {

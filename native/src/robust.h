@@ -123,6 +123,9 @@ class RobustComm : public Comm {
   bool local_expected_ = false;
 
   int recover_counter_ = 0;
+  // rabit_collective_retries: bound on in-collective recovery loops
+  // (was a hardcoded 1000) — the retry rung of the escalation ladder
+  int collective_retries_ = 1000;
 };
 
 }  // namespace rt

@@ -128,9 +128,42 @@ def smoke() -> int:
             f"expected 1 tracker_partition event: {proxy.events}"
         assert took >= 0.35, \
             f"partition window never stalled the stream ({took:.2f}s)"
+
+    # round 4: bitflip (ISSUE 13) — exactly one chunk is silently
+    # corrupted mid-stream (the bytes still flow, just wrong); the
+    # client detects the mangled echo and the retry lands clean, the
+    # application-level analog of the frame-CRC reject+retransmit path
+    flip_sched = Schedule([Rule("bitflip", after_bytes=4096, max_times=1)],
+                          seed=11)
+    with ChaosProxy(host, port, flip_sched, name="chaos-smoke-flip") as proxy:
+
+        def flip_trip() -> bytes:
+            conn = retry.connect_with_retry(proxy.host, proxy.port,
+                                            timeout=5.0)
+            with conn:
+                conn.sendall(payload)
+                conn.shutdown(socket.SHUT_WR)
+                out = b""
+                while True:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        break
+                    out += chunk
+                if out != payload:
+                    raise ConnectionError(
+                        f"corrupt echo ({len(out)} bytes, "
+                        f"{sum(a != b for a, b in zip(out, payload))} "
+                        f"byte(s) wrong)")
+                return out
+
+        retry.retry_call(flip_trip, attempts=4, base_s=0.05,
+                         desc="chaos bitflip round-trip")
+        flips = [e for e in proxy.events if e[1] == "bitflip"]
+        assert len(flips) == 1, f"expected 1 bitflip event: {proxy.events}"
+        assert proxy.accepted >= 2, "corruption was never detected/retried"
     srv.close()
-    print("chaos smoke ok (1 reset + 1 tracker_kill + 1 "
-          "tracker_partition injected, retry recovered, payload intact)")
+    print("chaos smoke ok (1 reset + 1 tracker_kill + 1 tracker_partition "
+          "+ 1 bitflip injected, retry recovered, payload intact)")
     return 0
 
 

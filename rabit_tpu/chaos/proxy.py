@@ -19,6 +19,10 @@ is applied to the byte stream itself:
 - ``blackout``    inside ``window_s`` new connections are accepted and
                   immediately RST — the tracker-down shape that the
                   connect-retry path must absorb
+- ``bitflip``     XOR 1-4 seeded random bytes of one forwarded chunk —
+                  the silent-corruption shape (flaky NIC, bad cable)
+                  that only end-to-end payload CRC catches; the bytes
+                  still flow, just wrong
 
 Faults fire on the proxy's own threads; the proxied processes observe
 only their sockets misbehaving, exactly as with real network faults.
@@ -27,6 +31,7 @@ No-fault configs forward byte-exactly (pinned by tier-1 tests).
 
 from __future__ import annotations
 
+import random
 import select
 import socket
 import struct
@@ -328,6 +333,29 @@ class ChaosProxy:
         with self._lock:
             total = conn.nbytes + len(chunk)
             conn.nbytes = total
+        for rule in conn.rules:
+            # seeded per-draw corruption: the rng key folds in the
+            # firing count so each flip of a multi-shot rule corrupts
+            # different bytes, while two runs with the same seed and
+            # accept order corrupt byte-identically
+            if rule.kind != "bitflip":
+                continue
+            if rule.window_s is not None and not self._in_window(rule):
+                continue
+            if rule.after_bytes and total < rule.after_bytes:
+                continue
+            draw = rule.fired
+            if not Schedule.consume(rule):
+                continue
+            rng = random.Random(
+                (self.schedule.seed * 1_000_003 + conn.index)
+                * 1_000_003 + draw)
+            corrupt = bytearray(chunk)
+            for _ in range(rng.randint(1, min(4, len(corrupt)))):
+                pos = rng.randrange(len(corrupt))
+                corrupt[pos] ^= rng.randint(1, 255)  # never a no-op flip
+            chunk = bytes(corrupt)
+            self._event("bitflip", conn.index)
         trigger = next(
             (r for r in conn.rules
              if r.kind in ("reset", "partial") and total >= r.after_bytes),

@@ -11,7 +11,8 @@ Rule fields (all optional except ``kind``):
 
 ========== ===========================================================
 ``kind``   ``delay`` | ``reset`` | ``partial`` | ``partition`` |
-           ``blackout`` | ``tracker_kill`` | ``tracker_partition``
+           ``blackout`` | ``tracker_kill`` | ``tracker_partition`` |
+           ``bitflip``
 ``conn``   apply only to the nth accepted connection (0-based);
            ``None`` = every connection
 ``prob``   apply with this probability (seeded draw); default 1.0
@@ -36,7 +37,13 @@ Rule fields (all optional except ``kind``):
            proxies keep flowing (the leader-partition shape: the data
            plane is healthy, the control plane is unreachable — what
            hot-standby failover must catch; requires ``window_s``,
-           implicitly ``target="tracker"`` unless overridden)
+           implicitly ``target="tracker"`` unless overridden);
+           ``bitflip`` XORs 1-4 seeded random bytes of one forwarded
+           chunk inside the window (the silent-corruption shape the
+           frame-CRC data plane must reject and retransmit; requires
+           ``window_s``, ``after_bytes`` or ``conn`` as an anchor,
+           defaults ``max_times`` to 1, usually ``target="link"`` —
+           the control-plane protocol has no CRC layer)
 ``target``  ``"tracker"`` | ``"link"`` | ``None`` (both, the
            default): which proxy class runs the rule. Link wiring has
            no retry around an accepted-then-reset handshake (a peer
@@ -56,7 +63,7 @@ import random
 from typing import List, Optional, Sequence, Tuple
 
 KINDS = ("delay", "reset", "partial", "partition", "blackout",
-         "tracker_kill", "tracker_partition")
+         "tracker_kill", "tracker_partition", "bitflip")
 TARGETS = ("tracker", "link")
 
 
@@ -90,6 +97,17 @@ class Rule:
             if window_s is None and conn is None:
                 raise ValueError(
                     "chaos 'tracker_kill' rule requires window_s or conn")
+            if max_times is None:
+                max_times = 1
+        if kind == "bitflip":
+            # corruption must be anchored like tracker_kill — an
+            # unanchored flip would corrupt the very first registration
+            # bytes instead of an established collective stream — and
+            # defaults to one firing (sustained corruption is a
+            # different experiment than a transient fault)
+            if window_s is None and conn is None and not after_bytes:
+                raise ValueError("chaos 'bitflip' rule requires window_s, "
+                                 "after_bytes or conn")
             if max_times is None:
                 max_times = 1
         if target is not None and target not in TARGETS:

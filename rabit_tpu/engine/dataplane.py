@@ -116,6 +116,23 @@ class XlaDataPlane:
         fail_at = os.environ.get("RABIT_DATAPLANE_FAIL_AT")
         self._fail_at: Optional[int] = int(fail_at) if fail_at else None
         self._invocations = 0
+        # Self-healing retry rung (ISSUE 13): with
+        # RABIT_COLLECTIVE_RETRIES=N > 0 a failed device collective is
+        # re-run in place up to N times from a cached copy of its input
+        # — the world is re-formed at the SAME epoch, C++ never sees the
+        # failure, no rank is evicted. 0 (the default) preserves the
+        # pre-ladder behavior exactly: first failure -> nonzero return
+        # -> link reset escalation.
+        retries = os.environ.get("RABIT_COLLECTIVE_RETRIES", "0")
+        try:
+            self._retries = max(0, int(retries))
+        except ValueError as e:
+            raise ValueError(
+                f"RABIT_COLLECTIVE_RETRIES must be an integer, "
+                f"got {retries!r}") from e
+        # Python-plane retry count for the live /metrics gauge (the
+        # native plane keeps its own counters behind RbtRecoveryStats)
+        self.retries_total = 0
         # EQuARX-style wire quantization for ring-path float SUMs
         # (rabit_dataplane_wire = bf16 | int8): compresses only the
         # ppermute'd ICI bytes; accumulation stays full-precision and
@@ -295,39 +312,92 @@ class XlaDataPlane:
                 print(f"[dataplane] teardown sentinel failed: {e}",
                       file=sys.stderr, flush=True)
             return 0
-        try:
-            if self._fail_at is not None and \
-                    self._invocations == self._fail_at:
-                self._fail_at = None  # fire exactly once
-                raise RuntimeError("scripted dataplane failure "
-                                   "(RABIT_DATAPLANE_FAIL_AT)")
-            self._invocations += 1
-            self.ensure_world(int(epoch))
-            dt = _ENUM_DTYPE[int(dtype)]
-            nbytes = int(count) * dt.itemsize
-            raw = np.ctypeslib.as_array(
-                ctypes.cast(buf_p, ctypes.POINTER(ctypes.c_uint8)),
-                shape=(nbytes,))
-            buf = raw.view(dt)
-            self._allreduce(buf, int(op))
-            return 0
-        except Exception as e:  # noqa: BLE001 — must not unwind into C
-            print(f"[dataplane] rank {self._rank} epoch {epoch} failed: "
-                  f"{type(e).__name__}: {e}", file=sys.stderr, flush=True)
-            # the nonzero return becomes a link reset on the C++ side:
-            # count it under recovery provenance so fleet tables show
-            # how many collectives escalated into the recovery path
-            telemetry.count("recovery.link_reset", op="dataplane",
-                            provenance="recovery")
-            from ..telemetry import flight
-            flight.note("link_reset",
-                        f"rank {self._rank} epoch {epoch}: "
-                        f"{type(e).__name__}: {e}")
+        # The per-collective round id: the C++ robust layer drives every
+        # rank through the same op sequence, so this counter is globally
+        # aligned across ranks and makes the retry idempotent — every
+        # attempt of round k re-runs the same reduction over the same
+        # cached inputs, and the replay log never sees a partial result.
+        round_id = self._invocations
+        pristine: Optional[np.ndarray] = None
+        buf: Optional[np.ndarray] = None
+        attempt = 0
+        while True:
             try:
-                self._teardown()
-            except Exception:  # pragma: no cover - best-effort
-                pass
-            return 1
+                if self._fail_at is not None and \
+                        round_id == self._fail_at:
+                    self._fail_at = None  # fire exactly once
+                    raise RuntimeError("scripted dataplane failure "
+                                       "(RABIT_DATAPLANE_FAIL_AT)")
+                if buf is None:
+                    self._invocations += 1
+                    dt = _ENUM_DTYPE[int(dtype)]
+                    nbytes = int(count) * dt.itemsize
+                    raw = np.ctypeslib.as_array(
+                        ctypes.cast(buf_p, ctypes.POINTER(ctypes.c_uint8)),
+                        shape=(nbytes,))
+                    buf = raw.view(dt)
+                    if self._retries > 0:
+                        # cache the round's input so a retry reduces the
+                        # SAME operands (buf is reduced in place)
+                        pristine = buf.copy()
+                self.ensure_world(int(epoch))
+                self._allreduce(buf, int(op))
+                if attempt > 0:
+                    import zlib
+                    from ..telemetry import flight
+                    flight.note(
+                        "recovery.retry",
+                        f"rank {self._rank} round {round_id} recovered "
+                        f"in-collective after {attempt} retr"
+                        f"{'y' if attempt == 1 else 'ies'} "
+                        f"crc={zlib.crc32(buf.tobytes()):08x}")
+                return 0
+            except Exception as e:  # noqa: BLE001 — must not unwind into C
+                if attempt < self._retries:
+                    # retry rung: restore the cached inputs, re-form the
+                    # device world at the SAME epoch (no membership
+                    # change, no eviction), back off, re-run the round
+                    attempt += 1
+                    self.retries_total += 1
+                    telemetry.count("recovery.retry", op="dataplane",
+                                    provenance="recovery")
+                    from ..telemetry import flight
+                    flight.note(
+                        "recovery.retry",
+                        f"rank {self._rank} round {round_id} attempt "
+                        f"{attempt}/{self._retries}: "
+                        f"{type(e).__name__}: {e}")
+                    print(f"[dataplane] rank {self._rank} round {round_id} "
+                          f"retry {attempt}/{self._retries} after "
+                          f"{type(e).__name__}: {e}",
+                          file=sys.stderr, flush=True)
+                    if pristine is not None and buf is not None:
+                        np.copyto(buf, pristine)
+                    try:
+                        self._teardown()
+                    except Exception:  # pragma: no cover - best-effort
+                        pass
+                    from ..utils.retry import backoff_delay
+                    time.sleep(backoff_delay(attempt - 1))
+                    continue
+                print(f"[dataplane] rank {self._rank} epoch {epoch} failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr,
+                      flush=True)
+                # retries exhausted (or disabled): the nonzero return
+                # becomes a link reset on the C++ side — count it under
+                # recovery provenance so fleet tables show how many
+                # collectives escalated past the retry rung
+                telemetry.count("recovery.link_reset", op="dataplane",
+                                provenance="recovery")
+                from ..telemetry import flight
+                flight.note("link_reset",
+                            f"rank {self._rank} epoch {epoch}: "
+                            f"{type(e).__name__}: {e}")
+                try:
+                    self._teardown()
+                except Exception:  # pragma: no cover - best-effort
+                    pass
+                return 1
 
     def _allreduce(self, buf: np.ndarray, op: int) -> None:
         import jax

@@ -112,6 +112,15 @@ def _load() -> ctypes.CDLL:
         ctypes.c_void_p, ctypes.c_size_t, ctypes.c_size_t,
         _RAW_REDUCE_CB, ctypes.c_void_p, _PREPARE_CB, ctypes.c_void_p,
         ctypes.c_char_p]
+    # self-healing data plane (ISSUE 13): out-of-band interrupt (reform
+    # rung), recovery provenance counters, and the frame CRC for tests
+    lib.RbtInterrupt.restype = ctypes.c_int
+    lib.RbtRecoveryStats.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.RbtRecoveryStats.restype = ctypes.c_int
+    lib.RbtFrameCrc32.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.RbtFrameCrc32.restype = ctypes.c_uint32
     return lib
 
 
@@ -148,6 +157,11 @@ class NativeEngine(Engine):
         # rabit_metrics_port HTTP endpoint + rabit_flight_dir recorder
         self._metrics_server = None
         self._flight = None
+        # last-seen native recovery counters (retries, frame rejects,
+        # link resurrections): _drain_recovery_stats diffs against these
+        # after each guarded collective and emits the delta as
+        # recovery-provenance telemetry events
+        self._recovery_seen = (0, 0, 0)
 
     def _cache_key(self, site: str, size: int) -> bytes:
         """Deterministic replay key: caller site + payload size + an
@@ -337,6 +351,12 @@ class NativeEngine(Engine):
         """Watchdog/recovery gauges served on /metrics next to the
         recorder counters (recovery *events* are counter rows already;
         these are the current-state reads)."""
+        retries = ctypes.c_uint64()
+        rejects = ctypes.c_uint64()
+        self._lib.RbtRecoveryStats(ctypes.byref(retries),
+                                   ctypes.byref(rejects), None)
+        dp = self._dataplane
+        py_retries = dp.retries_total if dp is not None else 0
         return [
             ("rabit_watchdog_expired_total",
              "Watchdog deadline expiries in this process.", "counter",
@@ -344,6 +364,12 @@ class NativeEngine(Engine):
             ("rabit_world_epoch",
              "Tracker link-registration epoch (advances on recovery).",
              "gauge", [({}, int(self._lib.RbtWorldEpoch()))]),
+            ("rabit_dataplane_retries_total",
+             "In-collective recovery retries (rounds re-run in place).",
+             "counter", [({}, int(retries.value) + py_retries)]),
+            ("rabit_frame_crc_rejects_total",
+             "CRC-rejected collective frames (retransmitted hop-local).",
+             "counter", [({}, int(rejects.value))]),
         ]
 
     @property
@@ -352,16 +378,53 @@ class NativeEngine(Engine):
         the worker set was rewired (a recovery happened)."""
         return int(self._lib.RbtWorldEpoch())
 
-    def _on_stall(self) -> None:
-        """Watchdog escalation hook: error the blocked device collective
-        by tearing the device world down — the data-plane callback then
-        returns nonzero to C++, which treats it as a link reset and
-        replays (doc/fault_tolerance.md). Host-side (pure C++ socket)
-        stalls are unreachable from here; the watchdog's grace-abort
-        handles those."""
+    def _rung_retry(self) -> None:
+        """Watchdog retry rung (first escalation): error the blocked
+        device collective by tearing the device world down — the
+        data-plane callback then either re-runs the round from its
+        cached inputs (RABIT_COLLECTIVE_RETRIES > 0) or returns nonzero
+        to C++, which treats it as a link reset and replays
+        (doc/fault_tolerance.md). Host-side (pure C++ socket) stalls are
+        unreachable from here; the reform rung handles those."""
+        telemetry.count("recovery.retry", op="watchdog_rung",
+                        provenance="recovery")
         dp = self._dataplane
         if dp is not None and dp.formed:
             dp.shutdown()
+
+    def _rung_reform(self) -> None:
+        """Watchdog reform rung (second escalation): the retry rung did
+        not unstick the phase — the stall is inside a C++ socket
+        collective. RbtInterrupt raises an out-of-band flag every native
+        poll loop checks; the blocked collective bails out into the
+        robust layer's global re-formation (ReconnectLinks + replay)
+        without process exit. Safe from the monitor thread."""
+        telemetry.count("recovery.world_reform", op="watchdog_rung",
+                        provenance="recovery")
+        self._lib.RbtInterrupt()
+
+    def _drain_recovery_stats(self) -> None:
+        """Diff the native recovery counters (in-collective retries,
+        CRC frame rejects, link resurrections) against the last drain
+        and emit the delta as recovery-provenance telemetry — the
+        native plane recovers without unwinding into Python, so this is
+        the only place those events reach the fleet tables."""
+        r = ctypes.c_uint64()
+        f = ctypes.c_uint64()
+        s = ctypes.c_uint64()
+        if self._lib.RbtRecoveryStats(ctypes.byref(r), ctypes.byref(f),
+                                      ctypes.byref(s)) != 0:
+            return
+        cur = (r.value, f.value, s.value)
+        prev, self._recovery_seen = self._recovery_seen, cur
+        names = ("recovery.retry", "recovery.frame_reject",
+                 "recovery.link_resurrect")
+        ops = ("native_round", "frame_crc", "link")
+        for name, op, c, p in zip(names, ops, cur, prev):
+            # counters are monotonic; cap the replay so a missed drain
+            # after thousands of events cannot stall the caller
+            for _ in range(min(max(0, c - p), 1000)):
+                telemetry.count(name, op=op, provenance="recovery")
 
     def set_world_reformed_callback(self, fn) -> None:
         """``fn(epoch)`` fires after each device-world re-formation; use
@@ -452,7 +515,8 @@ class NativeEngine(Engine):
                 fn()
             cb = _PREPARE_CB(trampoline)
         with self._watchdog.guard("engine.allreduce", nbytes=buf.nbytes,
-                                  on_expire=self._on_stall), \
+                                  on_expire=self._rung_retry,
+                                  on_reform=self._rung_reform), \
                 telemetry.span("engine.allreduce", nbytes=buf.nbytes,
                                op=OP_NAMES.get(op, str(op)),
                                method="native",
@@ -462,6 +526,7 @@ class NativeEngine(Engine):
                 buf.ctypes.data_as(ctypes.c_void_p), buf.size, dtype_enum,
                 op, cb, None, cache_key)
         self._check(rc, "allreduce")
+        self._drain_recovery_stats()
 
     def broadcast(self, data: Optional[bytes], root: int) -> bytes:
         # two-phase: 8-byte length then payload (reference rabit.py:171-206)
@@ -472,7 +537,8 @@ class NativeEngine(Engine):
                 raise ValueError("root must provide broadcast data")
             length[0] = len(data)
         with self._watchdog.guard("engine.broadcast.size", nbytes=8,
-                                  on_expire=self._on_stall):
+                                  on_expire=self._rung_retry,
+                                  on_reform=self._rung_reform):
             rc = self._lib.RbtBroadcastEx(
                 length.ctypes.data_as(ctypes.c_void_p), 8, root,
                 self._cache_key(site + "/len", 8))
@@ -483,7 +549,8 @@ class NativeEngine(Engine):
             payload.raw = data
         if n:
             with self._watchdog.guard("engine.broadcast", nbytes=n,
-                                      on_expire=self._on_stall), \
+                                      on_expire=self._rung_retry,
+                                      on_reform=self._rung_reform), \
                     telemetry.span("engine.broadcast", nbytes=n,
                                    method="native", root=root,
                                    round=telemetry.collective_round(
@@ -492,12 +559,14 @@ class NativeEngine(Engine):
                     ctypes.cast(payload, ctypes.c_void_p), n, root,
                     self._cache_key(site + "/payload", n))
             self._check(rc, "broadcast(payload)")
+        self._drain_recovery_stats()
         return payload.raw[:n]
 
     def load_checkpoint(self, with_local: bool = False
                         ) -> Tuple[int, Optional[bytes], Optional[bytes]]:
         with self._watchdog.guard("engine.load_checkpoint",
-                                  on_expire=self._on_stall):
+                                  on_expire=self._rung_retry,
+                                  on_reform=self._rung_reform):
             gptr = ctypes.POINTER(ctypes.c_char)()
             glen = ctypes.c_uint64()
             if with_local:
@@ -510,6 +579,7 @@ class NativeEngine(Engine):
                 lptr = llen = None
                 version = self._lib.RbtLoadCheckpoint(
                     ctypes.byref(gptr), ctypes.byref(glen), None, None)
+        self._drain_recovery_stats()
         if version < 0:
             self._check(-1, "load_checkpoint")
         gbytes = bytes(gptr[:glen.value]) if version > 0 else None
@@ -656,10 +726,12 @@ class NativeEngine(Engine):
         from ..telemetry import flight as _fl
         old_world = self.world_size
         with self._watchdog.guard("engine.resize",
-                                  on_expire=self._on_stall), \
+                                  on_expire=self._rung_retry,
+                                  on_reform=self._rung_reform), \
                 telemetry.span("engine.resize", op=cmd,
                                provenance="membership"):
             self._check(self._lib.RbtResize(cmd.encode()), "resize")
+        self._drain_recovery_stats()
         world = self.world_size
         log.set_identity(self.rank, world)
         if self.is_distributed:

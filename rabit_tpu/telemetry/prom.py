@@ -88,6 +88,9 @@ METRIC_FAMILIES = (
     "rabit_tracker_role",
     "rabit_repl_acked_seq",
     "rabit_repl_lag_records",
+    # self-healing data plane (engine/native.py, ISSUE 13)
+    "rabit_dataplane_retries_total",
+    "rabit_frame_crc_rejects_total",
 )
 
 

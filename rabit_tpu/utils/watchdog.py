@@ -8,21 +8,30 @@ a recv with no error to react to — the one failure mode the epoch
 machinery cannot see. The watchdog converts that stall into a detected
 failure: each guarded phase registers a deadline scaled by payload size
 with a floor (``rabit_deadline_ms`` + ``rabit_deadline_ms_per_mb``);
-a monitor thread escalates expiry in two steps:
+a monitor thread escalates expiry up a three-rung ladder (ISSUE 13:
+``exit 86`` is the LAST resort, reached only when in-process recovery
+is itself stuck):
 
-1. **expire**: record a ``watchdog.expired`` telemetry counter and a
-   ``recovery``-provenance span carrying the stall-so-far, log a
-   warning, and fire the guard's ``on_expire`` hook — the XLA data
-   plane registers a device-world teardown here, which errors the
-   blocked collective so the C++ plane treats it as a link reset and
-   replays (the *link reset* escalation).
-2. **abort** (grace = one more deadline, floor 0.5 s): if the phase is
-   STILL running — the stall is inside code Python cannot unwind, e.g.
-   a C++ socket recv — exit the process with code
+1. **retry** (at expiry): record a ``watchdog.expired`` telemetry
+   counter and a ``recovery``-provenance span carrying the
+   stall-so-far, log a warning, and fire the guard's ``on_expire``
+   hook — the XLA data plane registers a device-world teardown here,
+   which errors the blocked collective so the C++ plane re-runs the
+   round in place (the in-collective retry rung).
+2. **reform** (one more deadline later, floor 0.5 s): the retry rung
+   did not unstick the phase, so fire the guard's ``on_reform`` hook —
+   the native engine registers ``RbtInterrupt()`` here, which bails
+   the blocked socket collective out into the robust layer's global
+   re-formation (the elastic ``ReconnectLinks`` path) without exiting.
+   With ``rabit_watchdog_abort=0`` the ladder STOPS here: the stall is
+   recorded as a ``watchdog.stall`` flight note and the guard is
+   dropped, instead of the pre-ladder behavior of spinning silently
+   forever.
+3. **abort** (another deadline later): if the phase is STILL running —
+   recovery itself is stuck — exit the process with code
    :data:`WATCHDOG_EXIT_CODE`. To every peer that is a plain link
    reset; to the launcher it is a respawn; the epoch advances and the
-   replay machinery does the rest. ``rabit_watchdog_abort=0`` keeps
-   step 1 only (detect + report, never kill).
+   replay machinery does the rest.
 
 Deadlines are **opt-in** (``rabit_deadline_ms=0`` disables): a
 watchdog mis-sized for the slowest healthy collective converts
@@ -62,17 +71,20 @@ class _Guard:
     """One armed phase. Context manager; disarms on exit."""
 
     __slots__ = ("_wd", "name", "nbytes", "deadline_s", "on_expire",
-                 "t0", "expired", "done")
+                 "on_reform", "t0", "expired", "reformed", "done")
 
     def __init__(self, wd: "Watchdog", name: str, nbytes: int,
                  deadline_s: float,
-                 on_expire: Optional[Callable[[], None]]):
+                 on_expire: Optional[Callable[[], None]],
+                 on_reform: Optional[Callable[[], None]] = None):
         self._wd = wd
         self.name = name
         self.nbytes = nbytes
         self.deadline_s = deadline_s
         self.on_expire = on_expire
+        self.on_reform = on_reform
         self.expired = False
+        self.reformed = False
         self.done = False
 
     def __enter__(self):
@@ -140,15 +152,21 @@ class Watchdog:
 
     def guard(self, name: str, nbytes: int = 0,
               deadline_s: Optional[float] = None,
-              on_expire: Optional[Callable[[], None]] = None):
+              on_expire: Optional[Callable[[], None]] = None,
+              on_reform: Optional[Callable[[], None]] = None):
         """Deadline context for one phase. Disabled watchdogs hand back
-        a shared no-op guard (zero threads, zero locking)."""
+        a shared no-op guard (zero threads, zero locking).
+
+        ``on_expire`` fires at the retry rung (deadline expiry);
+        ``on_reform`` one deadline later, when the retry did not
+        unstick the phase — the hook should trigger global world
+        re-formation (e.g. ``RbtInterrupt``) without exiting."""
         if deadline_s is None:
             deadline_s = scale_deadline_s(nbytes, self.floor_ms,
                                           self.ms_per_mb)
         if deadline_s <= 0:
             return NULL_GUARD
-        return _Guard(self, name, nbytes, deadline_s, on_expire)
+        return _Guard(self, name, nbytes, deadline_s, on_expire, on_reform)
 
     def close(self) -> None:
         with self._cv:
@@ -183,30 +201,48 @@ class Watchdog:
                 now = time.monotonic()
                 wake = None
                 fire = None
+                reform = None
                 kill = None
                 for g in self._guards:
                     expiry = g.t0 + g.deadline_s
-                    grace = expiry + max(_MIN_GRACE_S, g.deadline_s)
+                    gap = max(_MIN_GRACE_S, g.deadline_s)
+                    reform_at = expiry + gap
+                    abort_at = expiry + 2 * gap
                     if not g.expired and now >= expiry:
                         fire = g
                         break
-                    if g.expired and self.abort and now >= grace:
+                    if g.expired and not g.reformed and now >= reform_at:
+                        reform = g
+                        break
+                    if g.reformed and self.abort and now >= abort_at:
                         kill = g
                         break
-                    nxt = grace if g.expired else expiry
-                    wake = nxt if wake is None else min(wake, nxt)
-                if fire is None and kill is None:
+                    if not g.expired:
+                        nxt = expiry
+                    elif not g.reformed:
+                        nxt = reform_at
+                    elif self.abort:
+                        nxt = abort_at
+                    else:
+                        nxt = None  # ladder stopped at reform (abort=0)
+                    if nxt is not None:
+                        wake = nxt if wake is None else min(wake, nxt)
+                if fire is None and reform is None and kill is None:
                     self._cv.wait(None if wake is None
                                   else max(0.01, wake - now))
                     continue
                 if fire is not None:
                     fire.expired = True
                     self.expired_total += 1
-            # escalation runs OUTSIDE the lock: on_expire may take
-            # arbitrary time (device-world teardown) and new guards must
-            # stay armable meanwhile
+                elif reform is not None:
+                    reform.reformed = True
+            # escalation runs OUTSIDE the lock: on_expire/on_reform may
+            # take arbitrary time (device-world teardown) and new guards
+            # must stay armable meanwhile
             if fire is not None:
                 self._escalate(fire)
+            elif reform is not None:
+                self._reform(reform)
             elif kill is not None:
                 self._abort(kill)
                 return
@@ -223,15 +259,39 @@ class Watchdog:
                     f"{g.name} stalled {stalled:.1f}s "
                     f"(deadline {g.deadline_s:.1f}s)")
         log.log_warn("watchdog: %s stalled %.1fs past its %.1fs deadline; "
-                     "escalating to link reset%s", g.name, stalled,
-                     g.deadline_s,
-                     " (abort on further stall)" if self.abort else "")
+                     "escalating to in-collective retry (reform%s on "
+                     "further stall)", g.name, stalled, g.deadline_s,
+                     ", then abort" if self.abort else "")
         if g.on_expire is not None:
             try:
                 g.on_expire()
             except Exception as e:  # noqa: BLE001 - escalation best-effort
                 log.log_warn("watchdog: on_expire for %s failed: %s",
                              g.name, e)
+
+    def _reform(self, g: _Guard) -> None:
+        stalled = time.monotonic() - g.t0
+        from .. import telemetry
+        telemetry.count("watchdog.reform", nbytes=g.nbytes, op=g.name,
+                        provenance="recovery")
+        log.log_warn("watchdog: %s still stalled %.1fs after retry rung; "
+                     "escalating to world re-formation%s", g.name, stalled,
+                     " (abort on further stall)" if self.abort else "")
+        if g.on_reform is not None:
+            try:
+                g.on_reform()
+            except Exception as e:  # noqa: BLE001 - escalation best-effort
+                log.log_warn("watchdog: on_reform for %s failed: %s",
+                             g.name, e)
+        if not self.abort:
+            # ladder top with abort opted out: record the stall in the
+            # flight recorder and stop tracking the guard — the
+            # pre-ladder behavior was to keep spinning silently forever
+            from ..telemetry import flight
+            flight.note("watchdog.stall",
+                        f"{g.name} stalled {stalled:.1f}s past reform rung; "
+                        f"rabit_watchdog_abort=0, ladder stops here")
+            self._disarm(g)
 
     def _abort(self, g: _Guard) -> None:
         from .. import telemetry

@@ -421,7 +421,8 @@ def test_abort_fires_after_grace_via_seam():
     wd = Watchdog(floor_ms=100, abort=True, abort_fn=codes.append)
     try:
         with wd.guard("dead.phase"):
-            # deadline 0.1s + grace max(0.5, 0.1)s: abort lands ~0.6s in
+            # deadline 0.1s + two rungs of max(0.5, 0.1)s each (retry ->
+            # reform -> abort): abort lands ~1.1s in
             deadline = time.monotonic() + 3.0
             while not codes and time.monotonic() < deadline:
                 time.sleep(0.02)
@@ -435,7 +436,7 @@ def test_abort_opt_out_stops_at_escalation():
     wd = Watchdog(floor_ms=50, abort=False, abort_fn=codes.append)
     try:
         with wd.guard("stuck.phase") as g:
-            time.sleep(0.7)  # well past deadline + grace
+            time.sleep(0.7)  # well past the retry and reform rungs
         assert g.expired and codes == []
     finally:
         wd.close()

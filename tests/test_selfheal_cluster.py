@@ -1,0 +1,187 @@
+"""Self-healing data plane, cluster level (slow tier, ISSUE 13): a
+real 4-process native-engine world with CRC-framed collectives
+(``rabit_frame_crc=1``) survives mid-collective wire faults entirely
+in-process — seeded ``bitflip`` corruption is rejected hop-local and
+retransmitted, a link RST is repaired in place by resurrection — and
+proves it the strong way: ``total_attempts == 0`` (no process ever
+exited), zero evictions, and per-rank collective CRC streams
+bit-identical to a fault-free baseline run
+(doc/fault_tolerance.md "Self-healing data plane")."""
+
+import os
+import re
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "native", "build", "librabit_tpu_core.so")
+WORKERS = os.path.join(ROOT, "tests", "workers")
+
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(not os.path.isfile(LIB),
+                       reason="native core not built"),
+]
+
+sys.path.insert(0, ROOT)
+
+N = 4
+ARGS = ["rabit_frame_crc=1"]
+
+
+def _run(out_dir, chaos=None):
+    from rabit_tpu.tracker.launch import launch
+    os.makedirs(out_dir, exist_ok=True)
+    cmd = [sys.executable, os.path.join(WORKERS, "selfheal_worker.py")] + ARGS
+    stats = {}
+    old = {}
+    env = {"SELFHEAL_OUT": out_dir, "RABIT_TELEMETRY": "1"}
+    for k, v in env.items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        rc = launch(N, cmd, max_attempts=3, timeout=180, stats=stats,
+                    chaos=chaos)
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rc, stats
+
+
+def _rounds(out_dir, rank, tag):
+    with open(os.path.join(out_dir, f"r{rank}.log")) as f:
+        lines = f.read().splitlines()
+    out = []
+    for ln in lines:
+        m = re.match(rf"{tag} round=(\d+) world=(\d+) "
+                     r"crc=([0-9a-f]{8})$", ln)
+        if m:
+            out.append((int(m.group(1)), int(m.group(2)), m.group(3)))
+    return lines, out
+
+
+def _counter_names(stats):
+    fleet = stats.get("fleet_metrics")
+    if not fleet:
+        return set()
+    return {(c["name"], c.get("provenance", ""))
+            for c in fleet.get("counters", [])}
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    """One fault-free framed-CRC run shared by every fault scenario:
+    the bit-exactness + epoch reference. Returns (log_dir, epoch)."""
+    out = str(tmp_path_factory.mktemp("selfheal-baseline"))
+    rc, stats = _run(out)
+    assert rc == 0
+    assert stats["total_attempts"] == 0, stats
+    return out, stats["membership"]["epoch"]
+
+
+def _assert_streams_match(fault_dir, baseline_dir):
+    """Every rank's every collective is bit-identical to the fault-free
+    baseline — corruption never leaked past the wire."""
+    for r in range(N):
+        lines, sums = _rounds(fault_dir, r, "sum")
+        _, sums_b = _rounds(baseline_dir, r, "sum")
+        _, bcasts = _rounds(fault_dir, r, "bcast")
+        _, bcasts_b = _rounds(baseline_dir, r, "bcast")
+        assert sums and sums == sums_b, f"rank {r} sum stream diverged"
+        assert bcasts and bcasts == bcasts_b, \
+            f"rank {r} bcast stream diverged"
+        assert "done" in lines, (r, lines)
+
+
+def _assert_healed_in_process(stats, fault_dir, baseline):
+    """The headline asserts shared by every fault scenario."""
+    baseline_dir, baseline_epoch = baseline
+    # nothing exited, nothing was respawned, nobody was evicted, and
+    # the world was never re-registered: the entire recovery happened
+    # inside the collectives, below the epoch machinery
+    assert stats["total_attempts"] == 0, stats
+    doc = stats["membership"]
+    assert doc["evicted"] == [], doc
+    assert doc["epoch"] == baseline_epoch, doc
+    assert stats["chaos"]["events"] >= 1, "no fault ever fired"
+    _assert_streams_match(fault_dir, baseline_dir)
+
+
+def test_bitflips_rejected_hop_local_and_streams_bit_identical(
+        tmp_path, baseline):
+    """Seeded mid-collective payload corruption on every link proxy:
+    the frame CRC rejects the damaged frame, the sender retransmits
+    hop-local, and the run is indistinguishable from the baseline."""
+    out = str(tmp_path / "bitflip")
+    chaos = {"seed": 13, "rules": [
+        {"kind": "bitflip", "after_bytes": 65536, "max_times": 2,
+         "target": "link"}]}
+    rc, stats = _run(out, chaos=chaos)
+    assert rc == 0
+    _assert_healed_in_process(stats, out, baseline)
+    names = _counter_names(stats)
+    assert ("recovery.frame_reject", "recovery") in names, names
+
+
+def test_link_rst_resurrected_in_place(tmp_path, baseline):
+    """A mid-collective RST on a busy link: the framed engine redials
+    the SAME peer in place (ResurrectLink), the seq handshake proves
+    which frame was in flight, and the collective resumes — no global
+    re-formation, no respawn."""
+    out = str(tmp_path / "rst")
+    chaos = {"seed": 17, "rules": [
+        {"kind": "reset", "after_bytes": 65536, "max_times": 1,
+         "target": "link"}]}
+    rc, stats = _run(out, chaos=chaos)
+    assert rc == 0
+    _assert_healed_in_process(stats, out, baseline)
+    names = _counter_names(stats)
+    assert ("recovery.link_resurrect", "recovery") in names, names
+
+
+def test_combined_bitflips_and_rsts_heal_in_process(tmp_path, baseline):
+    """The acceptance schedule: corruption AND connection tears in the
+    same run — both rungs of the ladder engage, the run still finishes
+    with zero exits, zero evictions, an unchanged epoch, and streams
+    bit-identical to the baseline."""
+    out = str(tmp_path / "combined")
+    chaos = {"seed": 23, "rules": [
+        {"kind": "bitflip", "after_bytes": 65536, "max_times": 2,
+         "target": "link"},
+        {"kind": "reset", "after_bytes": 131072, "max_times": 1,
+         "target": "link"}]}
+    rc, stats = _run(out, chaos=chaos)
+    assert rc == 0
+    _assert_healed_in_process(stats, out, baseline)
+    names = {n for n, _ in _counter_names(stats)}
+    assert {"recovery.frame_reject", "recovery.link_resurrect"} & names, \
+        names
+
+
+def test_knobs_unset_runs_head_wire_path_bit_identically(
+        tmp_path, baseline):
+    """With rabit_frame_crc unset the engine keeps the pre-ladder wire
+    format (no frames, no CRC, no resurrection) — and its collective
+    streams must be bit-identical to the framed run's, proving the
+    frame layer changes how bytes travel, never what they compute."""
+    from rabit_tpu.tracker.launch import launch
+    out = str(tmp_path / "unframed")
+    os.makedirs(out)
+    cmd = [sys.executable, os.path.join(WORKERS, "selfheal_worker.py")]
+    stats = {}
+    old = os.environ.get("SELFHEAL_OUT")
+    os.environ["SELFHEAL_OUT"] = out
+    try:
+        rc = launch(N, cmd, max_attempts=3, timeout=180, stats=stats)
+    finally:
+        if old is None:
+            os.environ.pop("SELFHEAL_OUT", None)
+        else:
+            os.environ["SELFHEAL_OUT"] = old
+    assert rc == 0
+    assert stats["total_attempts"] == 0, stats
+    _assert_streams_match(out, baseline[0])

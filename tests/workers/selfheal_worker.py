@@ -1,0 +1,69 @@
+"""Self-healing data-plane cluster worker
+(tests/test_selfheal_cluster.py, ISSUE 13).
+
+A native-engine rank streaming CRC-framed collectives
+(``rabit_frame_crc=1``) while chaos link proxies corrupt or tear the
+wire underneath it. Every round is a pure function of (round, world),
+so int64 sums are exact and the logged CRC streams are bit-comparable
+against a fault-free baseline run — the whole point: hop-local frame
+retransmission and link resurrection must heal the wire without the
+application seeing ANY difference (no wrong bytes, no exit, no respawn,
+no eviction).
+
+Payloads are deliberately large (512 KiB sums): the 16-byte frame
+headers are a vanishing fraction of the stream, so seeded bitflips
+land in CRC-protected payload bytes, exercising the reject+retransmit
+rung rather than the reset escalation.
+
+Exit 0 only if every collective on every rank was exact.
+"""
+
+import os
+import sys
+import zlib
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+TASK = os.environ.get("RABIT_TASK_ID", "?")
+OUT = os.environ["SELFHEAL_OUT"]
+N_SUM = int(os.environ.get("N_SUM", "6"))
+N_BCAST = int(os.environ.get("N_BCAST", "2"))
+COUNT = int(os.environ.get("SUM_COUNT", "65536"))  # x8 bytes = 512 KiB
+
+
+def log(msg):
+    with open(os.path.join(OUT, f"r{TASK}.log"), "a") as f:
+        f.write(msg + "\n")
+
+
+def main():
+    rabit.init([a for a in sys.argv[1:] if "=" in a], engine="native")
+    rank, world = rabit.get_rank(), rabit.get_world_size()
+    assert rabit.is_distributed()
+    log(f"formed rank={rank} world={world}")
+
+    for rnd in range(N_SUM):
+        a = np.arange(COUNT, dtype=np.int64) * (rank + 1) + rnd
+        out = rabit.allreduce(a, rabit.SUM)
+        expect = (np.arange(COUNT, dtype=np.int64)
+                  * (world * (world + 1) // 2) + rnd * world)
+        np.testing.assert_array_equal(out, expect)
+        log(f"sum round={rnd} world={world} "
+            f"crc={zlib.crc32(out.tobytes()):08x}")
+
+    for rnd in range(N_BCAST):
+        blob = (np.arange(32768, dtype=np.int64) + rnd).tobytes()  # 256 KiB
+        got = rabit.broadcast(blob if rank == 0 else None, 0)
+        assert got == blob, f"bcast round {rnd} corrupted"
+        log(f"bcast round={rnd} world={world} crc={zlib.crc32(got):08x}")
+
+    log("done")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -56,6 +56,12 @@ this implements the highest-signal subset with only the stdlib:
   journal is state a resumed tracker silently forgets. ``__init__``
   and replay-path functions (``_replay*``) are exempt: they *are* the
   recovery side.
+- **uncounted recovery paths** (R004, repo-specific): every data-plane
+  recovery path (the R004_RECOVERY map — in-collective retry, the
+  watchdog retry/reform rungs, link resurrection draining, in-process
+  resize) must record its provenance counter before re-entering the
+  collective, mirroring T002 — a run that silently healed itself N
+  times is indistinguishable from a healthy one in fleet tables.
 
 ``scripts/run_tests.sh`` prefers ``ruff check`` when installed; this is
 the fallback so the tier never silently no-ops. Exit 0 clean, 1 with
@@ -109,6 +115,45 @@ COUNTER_REQUIRED = {
 }
 
 _COUNTER_CALL_NAMES = {"count", "record_span", "record_dispatch"}
+
+# R004: data-plane recovery paths (ISSUE 13 self-healing ladder). Every
+# function that re-enters a collective after a fault — the in-collective
+# retry, the watchdog rungs, the native counter drain, the in-process
+# resize — must record its provenance counter (telemetry.count /
+# record_span / record_dispatch) BEFORE/while re-entering, mirroring
+# T002: a recovery that leaves no counter is invisible to fleet tables
+# and makes "the run healed itself N times" unanswerable post-hoc.
+R004_RECOVERY = {
+    os.path.join("rabit_tpu", "engine", "dataplane.py"): {
+        "_invoke", "_form_world"},
+    os.path.join("rabit_tpu", "engine", "native.py"): {
+        "_rung_retry", "_rung_reform", "_drain_recovery_stats",
+        "epoch_reset"},
+    os.path.join("rabit_tpu", "utils", "watchdog.py"): {"_reform"},
+}
+
+
+def _r004_issues(rel, tree):
+    required = R004_RECOVERY.get(rel)
+    if not required:
+        return []
+    issues = []
+    seen = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in required and node.name not in seen:
+            seen.add(node.name)
+            if not _calls_any(node, _COUNTER_CALL_NAMES):
+                issues.append((
+                    rel, node.lineno, "R004",
+                    f"recovery path '{node.name}' records no provenance "
+                    "counter before re-entering the collective"))
+    for name in sorted(required - seen):
+        issues.append((rel, 1, "R004",
+                       f"expected recovery path '{name}' not found "
+                       "(update R004_RECOVERY)"))
+    return issues
+
 
 # R001: files allowed to construct sockets directly. Listeners/servers
 # (which accept rather than connect), the retry module itself, and the
@@ -447,6 +492,7 @@ def check_file(path: str):
     issues.extend(_r001_issues(rel, tree, src))
     issues.extend(_r002_issues(rel, tree))
     issues.extend(_r003_issues(rel, tree))
+    issues.extend(_r004_issues(rel, tree))
     issues.extend(_t003_issues(rel, tree))
     required = SPAN_REQUIRED.get(rel)
     if required:

@@ -90,17 +90,43 @@ def render_counters(doc):
     out = title + "\n\n" + _md_table(head, rows)
     # recovery sub-table: watchdog expiries, link resets, epoch
     # advances, world re-formations, cold restarts — the at-a-glance
-    # answer to "did this run survive anything, and what did it cost"
+    # answer to "did this run survive anything, and what did it cost".
+    # The rung column places each event on the self-healing escalation
+    # ladder (doc/fault_tolerance.md): frame -> retry -> reconnect ->
+    # reform -> abort, cheapest first.
     rec = [c for c in doc.get("counters", [])
            if (c.get("provenance") or "") == "recovery"]
     if rec:
-        rrows = [(c["name"], c["op"] or "-", c["count"],
-                  _fmt_bytes(c["bytes"]), _fmt_s(c["total_s"]),
+        rrows = [(c["name"], _recovery_rung(c["name"]), c["op"] or "-",
+                  c["count"], _fmt_bytes(c["bytes"]), _fmt_s(c["total_s"]),
                   _fmt_s(c["max_s"])) for c in rec]
         out += ("\n\nRecovery events ({} kind(s))\n\n".format(len(rec))
-                + _md_table(("event", "op", "count", "bytes", "total",
-                             "max"), rrows))
+                + _md_table(("event", "rung", "op", "count", "bytes",
+                             "total", "max"), rrows))
     return out
+
+
+# escalation-ladder rung per recovery event name: where on the
+# self-healing ladder the event sits (frame = hop-local CRC
+# retransmission, retry = round re-run in place, reconnect = link-level
+# repair, reform = global world re-formation, abort = last resort)
+_RECOVERY_RUNGS = {
+    "recovery.frame_reject": "frame",
+    "recovery.retry": "retry",
+    "recovery.link_reset": "reconnect",
+    "recovery.link_resurrect": "reconnect",
+    "recovery.epoch_advance": "reform",
+    "recovery.world_reform": "reform",
+    "watchdog.reform": "reform",
+    "watchdog.expired": "report",
+    "watchdog.stall": "report",
+    "watchdog.abort": "abort",
+    "recovery.cold_restart": "abort",
+}
+
+
+def _recovery_rung(name):
+    return _RECOVERY_RUNGS.get(name, "-")
 
 
 def render_trace(doc):
