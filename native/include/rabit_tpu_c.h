@@ -106,6 +106,18 @@ int RbtResize(const char* cmd);
  * when nothing consumes it. */
 int RbtInterrupt(void);
 
+/* RbtInterrupt with a provenance tag: ``reason`` (e.g. the watchdog
+ * escalation rung that fired) is recorded alongside the flag and shows
+ * up in recovery logs and RbtInterruptReason. NULL means "interrupt".
+ * Same any-thread safety contract as RbtInterrupt. */
+int RbtInterruptEx(const char* reason);
+
+/* Most recent interrupt reason ("" if never raised). Sticky — reading
+ * does not clear it, so post-recovery telemetry can attribute the last
+ * reset. The returned pointer is owned by the library and stays valid
+ * on the calling thread until its next RbtInterruptReason call. */
+const char* RbtInterruptReason(void);
+
 /* Recovery provenance counters (monotonic since Init): in-collective
  * round retries, CRC-rejected frames, and in-place link resurrections.
  * NULL out-pointers are skipped. */

@@ -1,0 +1,105 @@
+/* Clang thread-safety (capability) annotation macros + annotated lock
+ * wrappers for the native engine. Under clang, `-Wthread-safety` turns
+ * these into static lock-discipline checks (the C++ twin of the Python
+ * analyzer's C001/C002 — see doc/static_analysis.md); under gcc and
+ * every other compiler they expand to nothing, so annotated code
+ * compiles identically everywhere.
+ *
+ * Conventions mirror tools/analysis/locks.py:
+ *   - shared state is tagged RT_GUARDED_BY(mu)    (Python: # guarded-by: _mu)
+ *   - helpers that assume the lock use RT_REQUIRES (Python: *_locked suffix)
+ *   - lock-order constraints use RT_ACQUIRED_BEFORE/AFTER (Python: C002)
+ *
+ * The engine is per-thread (one Comm per thread slot, comm.cc); state
+ * that is "engine-thread only" rather than mutex-guarded is tagged with
+ * the kEngineThread ThreadRole capability instead of a real lock.
+ */
+#ifndef RT_THREAD_ANNOTATIONS_H_
+#define RT_THREAD_ANNOTATIONS_H_
+
+#if defined(__clang__) && defined(__has_attribute)
+#define RT_HAS_TSA_(x) __has_attribute(x)
+#else
+#define RT_HAS_TSA_(x) 0
+#endif
+
+#if RT_HAS_TSA_(capability)
+#define RT_TSA_(x) __attribute__((x))
+#else
+#define RT_TSA_(x)
+#endif
+
+#define RT_CAPABILITY(x) RT_TSA_(capability(x))
+#define RT_SCOPED_CAPABILITY RT_TSA_(scoped_lockable)
+#define RT_GUARDED_BY(x) RT_TSA_(guarded_by(x))
+#define RT_PT_GUARDED_BY(x) RT_TSA_(pt_guarded_by(x))
+#define RT_ACQUIRED_BEFORE(...) RT_TSA_(acquired_before(__VA_ARGS__))
+#define RT_ACQUIRED_AFTER(...) RT_TSA_(acquired_after(__VA_ARGS__))
+#define RT_REQUIRES(...) RT_TSA_(requires_capability(__VA_ARGS__))
+#define RT_REQUIRES_SHARED(...) \
+  RT_TSA_(requires_shared_capability(__VA_ARGS__))
+#define RT_ACQUIRE(...) RT_TSA_(acquire_capability(__VA_ARGS__))
+#define RT_ACQUIRE_SHARED(...) RT_TSA_(acquire_shared_capability(__VA_ARGS__))
+#define RT_RELEASE(...) RT_TSA_(release_capability(__VA_ARGS__))
+#define RT_TRY_ACQUIRE(...) RT_TSA_(try_acquire_capability(__VA_ARGS__))
+#define RT_EXCLUDES(...) RT_TSA_(locks_excluded(__VA_ARGS__))
+#define RT_ASSERT_CAPABILITY(x) RT_TSA_(assert_capability(x))
+#define RT_RETURN_CAPABILITY(x) RT_TSA_(lock_returned(x))
+#define RT_NO_THREAD_SAFETY_ANALYSIS RT_TSA_(no_thread_safety_analysis)
+
+#ifdef __cplusplus
+#include <mutex>
+
+namespace rt {
+
+// std::mutex with the capability attribute attached, so members can be
+// RT_GUARDED_BY it and functions can RT_REQUIRES/RT_EXCLUDES it.
+class RT_CAPABILITY("mutex") Mutex {
+ public:
+  Mutex() = default;
+  Mutex(const Mutex&) = delete;
+  Mutex& operator=(const Mutex&) = delete;
+  void lock() RT_ACQUIRE() { mu_.lock(); }
+  void unlock() RT_RELEASE() { mu_.unlock(); }
+  bool try_lock() RT_TRY_ACQUIRE(true) { return mu_.try_lock(); }
+
+ private:
+  std::mutex mu_;
+};
+
+// RAII guard the analysis understands (std::lock_guard<rt::Mutex>
+// would also check, but this keeps call sites annotation-free).
+class RT_SCOPED_CAPABILITY LockGuard {
+ public:
+  explicit LockGuard(Mutex& mu) RT_ACQUIRE(mu) : mu_(mu) { mu_.lock(); }
+  ~LockGuard() RT_RELEASE() { mu_.unlock(); }
+  LockGuard(const LockGuard&) = delete;
+  LockGuard& operator=(const LockGuard&) = delete;
+
+ private:
+  Mutex& mu_;
+};
+
+// Role capability: not a lock but a statically-checked claim that the
+// caller runs on a particular thread. Engine state that is per-thread
+// by design (the thread-local Comm slot) is RT_GUARDED_BY(kEngineThread);
+// entry points assert the role once via ThreadRoleScope so the analysis
+// rejects any path that touches engine state from a monitor thread.
+class RT_CAPABILITY("role") ThreadRole {};
+
+class RT_SCOPED_CAPABILITY ThreadRoleScope {
+ public:
+  explicit ThreadRoleScope(ThreadRole& role) RT_ACQUIRE(role)
+      : role_(role) {}
+  ~ThreadRoleScope() RT_RELEASE() {}
+  ThreadRoleScope(const ThreadRoleScope&) = delete;
+  ThreadRoleScope& operator=(const ThreadRoleScope&) = delete;
+
+ private:
+  ThreadRole& role_;
+};
+
+}  // namespace rt
+#endif  // __cplusplus
+
+#endif  // RT_THREAD_ANNOTATIONS_H_

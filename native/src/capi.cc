@@ -39,7 +39,11 @@ Comm* NewCommFromEnv(int argc, const char* const* argv) {
 }
 
 static std::string& LastError() {
-  static std::string err;
+  // thread_local to match the per-thread engine slot: two threads
+  // driving their own engines must not clobber each other's error
+  // (and the error of the thread that failed is the one its caller
+  // will fetch via RbtGetLastError)
+  static thread_local std::string err;
   return err;
 }
 
@@ -176,11 +180,14 @@ int RbtAllreduce(void* sendrecvbuf, size_t count, int dtype, int op,
 }
 
 // trampoline context for custom reducers: the engine's ReduceFn carries
-// no user pointer, so stash (fn, ctx) in globals for the duration of the
-// call — safe because the API is documented single-threaded, matching
-// the reference's static-buffer C ABI (c_api.cc:219-245).
-static RbtReduceFn g_custom_red = nullptr;
-static void* g_custom_ctx = nullptr;
+// no user pointer, so stash (fn, ctx) for the duration of the call.
+// thread_local, not global: the engine slot is per-thread, so the
+// trampoline always runs on the thread that stashed the pair — globals
+// here would let a second thread's Allreduce swap the reducer out from
+// under the first (matching the reference's static-buffer C ABI,
+// c_api.cc:219-245, which was documented single-threaded instead).
+static thread_local RbtReduceFn g_custom_red = nullptr;
+static thread_local void* g_custom_ctx = nullptr;
 
 static void CustomReduceTrampoline(void* dst, const void* src, size_t n) {
   g_custom_red(dst, src, n, g_custom_ctx);
@@ -214,8 +221,9 @@ int RbtBroadcast(void* sendrecvbuf, uint64_t size, int root) {
 }
 
 // static buffers keep checkpoints alive across the ABI (reference
-// c_api.cc:219-245; documented not thread-safe, as is the whole API)
-static std::string g_load_global, g_load_local;
+// c_api.cc:219-245). thread_local so each engine thread's checkpoint
+// survives until ITS next load, independent of other threads.
+static thread_local std::string g_load_global, g_load_local;
 
 int RbtLoadCheckpoint(const char** out_global, uint64_t* out_global_len,
                       const char** out_local, uint64_t* out_local_len) {
@@ -247,7 +255,9 @@ int RbtCheckpoint(const char* global, uint64_t global_len, const char* local,
 
 int RbtLazyCheckpoint(const char* global, uint64_t global_len) {
   RT_API_BEGIN();
-  static std::string lazy_buf;
+  // thread_local: the engine keeps a pointer to this buffer until the
+  // next checkpoint, and the engine slot itself is per-thread
+  static thread_local std::string lazy_buf;
   lazy_buf.assign(global ? global : "", global_len);
   GetComm()->LazyCheckpoint(&lazy_buf);
   RT_API_END();
@@ -266,8 +276,25 @@ int RbtInterrupt(void) {
   // no RT_API_BEGIN: just an atomic flag raise, and it must stay
   // safe from the watchdog monitor thread while the engine thread is
   // blocked inside a collective
-  rt::RequestInterrupt();
+  rt::RequestInterrupt("interrupt");
   return 0;
+}
+
+int RbtInterruptEx(const char* reason) {
+  // reason-tagged raise (watchdog rungs pass their escalation name so
+  // recovery logs can attribute the reset); same thread-safety
+  // contract as RbtInterrupt
+  rt::RequestInterrupt(reason ? reason : "interrupt");
+  return 0;
+}
+
+const char* RbtInterruptReason(void) {
+  // thread_local snapshot buffer: the returned pointer stays valid on
+  // the calling thread until its next RbtInterruptReason call, even if
+  // another thread raises a new interrupt meanwhile
+  static thread_local std::string snap;
+  snap = rt::LastInterruptReason();
+  return snap.c_str();
 }
 
 int RbtRecoveryStats(uint64_t* retries, uint64_t* frame_rejects,

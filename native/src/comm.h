@@ -16,6 +16,15 @@
 //  - errors surface as NetResult codes returned up through Try*;
 //    the robust subclass turns them into recovery, the base engine
 //    fails fast.
+//
+// Thread model (rt_thread_annotations.h): one Comm per thread — the C
+// ABI resolves a thread_local engine slot (comm.cc GetComm), so every
+// member below is engine-thread state needing no lock. The ONLY
+// cross-thread channels into a running collective are the interrupt
+// plane (net.h RequestInterrupt: atomic flag + mutex-guarded reason)
+// and the process-global tracker env; anything else shared across
+// threads must carry an rt::Mutex and RT_GUARDED_BY annotations so
+// clang's -Wthread-safety (and TSan, RT_SANITIZE=thread) can check it.
 #ifndef RT_COMM_H_
 #define RT_COMM_H_
 
@@ -115,6 +124,8 @@ class Comm {
 
   // Recovery provenance counters (self-healing data plane): drained by
   // the Python engine after each collective into telemetry rows.
+  // Engine-thread only, like every accessor here — the Python binding
+  // calls it from the thread that owns this Comm's thread_local slot.
   void GetRecoveryStats(uint64_t* retries, uint64_t* frame_rejects,
                         uint64_t* resurrects) const {
     if (retries) *retries = stat_retries_;
@@ -250,7 +261,10 @@ class Comm {
   std::string coord_host_;
   int coord_port_ = 0;
 
-  // self-healing data plane knobs + provenance counters
+  // self-healing data plane knobs + provenance counters.
+  // Engine-thread only (per-thread Comm slot); deliberately NOT
+  // atomic/locked — the watchdog monitor thread reaches a collective
+  // exclusively through net.h RequestInterrupt, never through these.
   bool frame_crc_ = false;      // rabit_frame_crc: CRC-framed payloads
   int frame_retries_ = 4;       // rabit_frame_retries: per-hop re-rounds
   int resurrect_ms_ = 5000;     // rabit_resurrect_ms: redial budget

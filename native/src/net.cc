@@ -49,11 +49,29 @@ uint32_t Crc32(const void* data, size_t n) {
 
 // Interrupt flag is process-global: the watchdog's monitor thread has
 // no engine handle, and the engine's thread-local comm slot would hide
-// a flag set from another thread anyway.
+// a flag set from another thread anyway. The flag is a bare atomic
+// (checked per poll iteration — no lock on the hot path); the reason
+// string cannot be atomic, so it gets its own mutex. Reason is written
+// BEFORE the flag is raised, so a consumer that saw the flag reads a
+// reason at least as new as the request it consumed.
 static std::atomic<bool> g_interrupt{false};
+static Mutex g_interrupt_mu;
+static std::string g_interrupt_reason RT_GUARDED_BY(g_interrupt_mu);
 
-void RequestInterrupt() { g_interrupt.store(true); }
+void RequestInterrupt(const std::string& reason) {
+  {
+    LockGuard hold(g_interrupt_mu);
+    g_interrupt_reason = reason;
+  }
+  g_interrupt.store(true);
+}
+
 bool TakeInterrupt() { return g_interrupt.exchange(false); }
+
+std::string LastInterruptReason() {
+  LockGuard hold(g_interrupt_mu);
+  return g_interrupt_reason;
+}
 
 TcpConn& TcpConn::operator=(TcpConn&& o) noexcept {
   if (this != &o) {

@@ -14,6 +14,8 @@
 #include <string>
 #include <vector>
 
+#include "rt_thread_annotations.h"
+
 namespace rt {
 
 // Outcome of a socket operation; the recovery layer keys off kReset
@@ -34,8 +36,18 @@ uint32_t Crc32(const void* data, size_t n);
 // robust layer can run its global-reset recovery instead of spinning
 // on a wedged link. File-scope (NOT per-comm/thread) on purpose — the
 // raiser is a monitor thread that holds no engine handle.
-void RequestInterrupt();
+//
+// ``reason`` is a provenance tag ("watchdog_reform", a test name, …)
+// carried alongside the flag: the raiser and the consumer are on
+// different threads, so it lives under its own mutex (the flag itself
+// stays a lone atomic — poll loops check it per iteration and must not
+// take a lock on the hot path). The last reason is sticky: recovery
+// logging reads it after the flag was consumed.
+void RequestInterrupt(const std::string& reason = "");
 bool TakeInterrupt();   // consume-and-clear; false when no request
+// most recent RequestInterrupt reason ("" if never raised); sticky —
+// reading does not clear, so post-recovery logs can attribute the reset
+std::string LastInterruptReason();
 
 class TcpConn {
  public:

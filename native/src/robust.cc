@@ -154,10 +154,14 @@ void RobustComm::ConsensusAllreduce(void* buf, size_t elem_size, size_t count,
 // reform rung) — converges here: peers blocked in Try* observe the conn
 // teardown as kReset and realign in the same global re-formation.
 void RobustComm::CheckAndRecover(NetResult res) {
-  (void)res;
   ++recover_counter_;
   ++stat_retries_;  // provenance counter, drained by the Python engine
-  if (debug_) {
+  if (res == NetResult::kInterrupt) {
+    // attribute the reset: the raiser tagged the request with its
+    // provenance (e.g. "watchdog_reform"), sticky in the net layer
+    LogInfo(StrFormat("rank %d recovery #%d from interrupt (%s)", rank_,
+                      recover_counter_, LastInterruptReason().c_str()));
+  } else if (debug_) {
     LogInfo(StrFormat("rank %d entering recovery #%d", rank_,
                       recover_counter_));
   }

@@ -25,6 +25,12 @@
 
 namespace rt {
 
+// Inherits Comm's thread model (comm.h): engine-thread state, no locks.
+// Recovery state (recover_counter_, checkpoint buffers, replay cache)
+// mutates only inside collectives on the owning thread; the watchdog's
+// reform rung lands via net.h's annotated interrupt plane and surfaces
+// here as NetResult::kInterrupt, so CheckAndRecover still runs on the
+// engine thread. TSan builds (RT_SANITIZE=thread) verify this holds.
 class RobustComm : public Comm {
  public:
   void Allreduce(void* buf, size_t elem_size, size_t count, ReduceFn reducer,

@@ -144,7 +144,9 @@ class ChaosProxy:
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "ChaosProxy":
-        self._t0 = time.monotonic()
+        # written once before the accept thread exists (Thread.start()
+        # is the happens-before edge), read-only afterwards
+        self._t0 = time.monotonic()  # noqa: C003
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name=f"{self.name}-accept")
         self._thread.start()
@@ -215,12 +217,14 @@ class ChaosProxy:
             except OSError:
                 break
             index = self.accepted
-            self.accepted += 1
+            # accept/refuse tallies have a single writer (this serve
+            # thread); tests read them only after stop()
+            self.accepted += 1  # noqa: C003
             rules = self.schedule.decide(index)
             blackout = next((r for r in rules if r.kind == "blackout"
                              and self._in_window(r)), None)
             if blackout is not None and Schedule.consume(blackout):
-                self.refused += 1
+                self.refused += 1  # noqa: C003 - single-writer tally
                 self._event("blackout", index)
                 _hard_close(client)
                 continue
@@ -241,7 +245,7 @@ class ChaosProxy:
                 except Exception as e:  # noqa: BLE001 - chaos never aborts
                     print(f"[{self.name}] kill hook failed: {e}",
                           file=sys.stderr, flush=True)
-                self.refused += 1
+                self.refused += 1  # noqa: C003 - single-writer tally
                 _hard_close(client)
                 continue
             with self._lock:
@@ -252,7 +256,7 @@ class ChaosProxy:
             except OSError:
                 # upstream genuinely down: behave like it (RST, since a
                 # refused connect surfaces as an error, not a hang)
-                self.refused += 1
+                self.refused += 1  # noqa: C003 - single-writer tally
                 _hard_close(client)
                 continue
             conn = _Conn(index, client, upstream, rules, self)

@@ -115,6 +115,12 @@ def _load() -> ctypes.CDLL:
     # self-healing data plane (ISSUE 13): out-of-band interrupt (reform
     # rung), recovery provenance counters, and the frame CRC for tests
     lib.RbtInterrupt.restype = ctypes.c_int
+    # reason-tagged interrupt plane (newer core builds; hasattr-gated so
+    # an older .so keeps working through plain RbtInterrupt)
+    if hasattr(lib, "RbtInterruptEx"):
+        lib.RbtInterruptEx.argtypes = [ctypes.c_char_p]
+        lib.RbtInterruptEx.restype = ctypes.c_int
+        lib.RbtInterruptReason.restype = ctypes.c_char_p
     lib.RbtRecoveryStats.argtypes = [
         ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint64)]
@@ -401,7 +407,10 @@ class NativeEngine(Engine):
         without process exit. Safe from the monitor thread."""
         telemetry.count("recovery.world_reform", op="watchdog_rung",
                         provenance="recovery")
-        self._lib.RbtInterrupt()
+        if hasattr(self._lib, "RbtInterruptEx"):
+            self._lib.RbtInterruptEx(b"watchdog_reform")
+        else:
+            self._lib.RbtInterrupt()
 
     def _drain_recovery_stats(self) -> None:
         """Diff the native recovery counters (in-collective retries,

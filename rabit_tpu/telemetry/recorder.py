@@ -164,7 +164,7 @@ class Recorder:
         key = (name, op or "", method or "", wire or "",
                size_bucket(nbytes), provenance)
         with self._lock:
-            self._bump(key, nbytes, None)
+            self._bump_locked(key, nbytes, None)
 
     def next_round(self, name: str) -> int:
         """Per-name collective sequence number (1-based). Engine call
@@ -205,9 +205,9 @@ class Recorder:
                 self._spans[self._head] = entry
                 self._head = (self._head + 1) % self.capacity
                 self.dropped += 1
-            self._bump(key, nbytes, dur_s)
+            self._bump_locked(key, nbytes, dur_s)
 
-    def _bump(self, key, nbytes, dur_s) -> None:
+    def _bump_locked(self, key, nbytes, dur_s) -> None:
         c = self._counters.get(key)
         if c is None:
             c = self._counters[key] = {

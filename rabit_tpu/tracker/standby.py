@@ -129,10 +129,13 @@ class StandbyTracker:
         self._lease_deadline: Optional[float] = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.tracker: Optional[_tracker_mod.Tracker] = None
-        self.acked_seq = 0
-        self.promoted_at: Optional[float] = None
-        self.resyncs = 0
+        # guards the state shared between the follow thread and the
+        # supervisor's alive()/promoted()/stop() probes (C001)
+        self._mu = threading.Lock()
+        self.tracker: Optional[_tracker_mod.Tracker] = None  # guarded-by: _mu
+        self.acked_seq = 0                                   # guarded-by: _mu
+        self.promoted_at: Optional[float] = None             # guarded-by: _mu
+        self.resyncs = 0                                     # guarded-by: _mu
 
     # -- lifecycle --------------------------------------------------------
     def start(self) -> "StandbyTracker":
@@ -148,20 +151,25 @@ class StandbyTracker:
             self._placeholder.close()
         except OSError:
             pass
-        if self.tracker is not None:
-            self.tracker.stop()
+        with self._mu:
+            tr = self.tracker
+        if tr is not None:
+            tr.stop()
         else:
             self._wal.close()
 
     def alive(self) -> bool:
         """True while the standby can still take over: following, or
         already promoted and serving."""
-        if self.tracker is not None:
-            return not self.tracker.crashed
+        with self._mu:
+            tr = self.tracker
+        if tr is not None:
+            return not tr.crashed
         return self._thread is not None and self._thread.is_alive()
 
     def promoted(self) -> bool:
-        return self.tracker is not None
+        with self._mu:
+            return self.tracker is not None
 
     def _log(self, msg: str) -> None:
         if not self._quiet:
@@ -204,7 +212,8 @@ class StandbyTracker:
                 ms = max(100, int(lease.get("lease_ms", ms)))
             except (TypeError, ValueError):
                 pass
-        self._lease_deadline = time.monotonic() + ms / 1e3
+        with self._mu:
+            self._lease_deadline = time.monotonic() + ms / 1e3
 
     def _may_promote(self) -> bool:
         """True once a full lease of silence elapsed on the local
@@ -213,9 +222,10 @@ class StandbyTracker:
         compares the leader-stamped ``until_ms`` against our wall
         clock: cross-host skew must not be able to promote under a
         live leader (see the module docstring)."""
-        return (self._lease is not None
-                and self._lease_deadline is not None
-                and time.monotonic() >= self._lease_deadline)
+        with self._mu:
+            return (self._lease is not None
+                    and self._lease_deadline is not None
+                    and time.monotonic() >= self._lease_deadline)
 
     def _follow_loop(self) -> None:
         backoff = 0.05
@@ -236,7 +246,8 @@ class StandbyTracker:
                             else None
                         self._restart_countdown(lease)
                         if lease is not None:
-                            self._lease = lease
+                            with self._mu:
+                                self._lease = lease
                         if seq == 0:
                             # ephemeral lease heartbeat: proof of life
                             # and a fresher doc, never journaled or
@@ -244,14 +255,16 @@ class StandbyTracker:
                             continue
                         seq = self._wal.append_encoded(frame)
                         conn.sendall(struct.pack("<I", seq))
-                        self.acked_seq = seq
+                        with self._mu:
+                            self.acked_seq = seq
                 except (OSError, ConnectionError, struct.error,
                         _wal_mod.WalError):
                     # torn stream, ack lost, or leader gone: resync by
                     # resubscribing from the last DURABLE seq — every
                     # acked record is already fsynced, so nothing acked
                     # can be lost
-                    self.resyncs += 1
+                    with self._mu:
+                        self.resyncs += 1
                 finally:
                     try:
                         conn.close()
@@ -262,7 +275,9 @@ class StandbyTracker:
             if self._may_promote():
                 self._promote()
                 return
-            if self._lease is None and conn is None:
+            with self._mu:
+                never_synced = self._lease is None
+            if never_synced and conn is None:
                 # never synced at all and the leader is unreachable:
                 # nothing to promote from — keep trying to subscribe
                 pass
@@ -281,8 +296,10 @@ class StandbyTracker:
             self._placeholder.close()
         except OSError:
             pass
+        with self._mu:
+            last_lease = self._lease
         self._log(f"no leader frame for a full lease "
-                  f"({self.lease_ms}ms, last lease {self._lease}); "
+                  f"({self.lease_ms}ms, last lease {last_lease}); "
                   f"promoting on {self.host}:{self.port} from seq "
                   f"{self._wal.seq}")
         deadline = time.monotonic() + 10
@@ -306,8 +323,9 @@ class StandbyTracker:
                 time.sleep(0.05)
         tr.promoted = True
         tr.start()
-        self.tracker = tr
-        self.promoted_at = time.monotonic()
+        with self._mu:
+            self.tracker = tr
+            self.promoted_at = time.monotonic()
         self._note_promotion()
 
     def _note_promotion(self) -> None:
@@ -315,17 +333,18 @@ class StandbyTracker:
         mirroring the tracker's own transition notes."""
         from .. import telemetry
         from ..telemetry import flight
+        with self._mu:
+            acked, resyncs, tr = self.acked_seq, self.resyncs, self.tracker
         telemetry.count("tracker.failover", provenance="tracker")
         telemetry.record_span("tracker.failover", 0.0, op="promote",
                               provenance="tracker",
-                              acked_seq=self.acked_seq,
-                              resyncs=self.resyncs)
+                              acked_seq=acked, resyncs=resyncs)
         flight.note("tracker_failover",
                     f"standby {self.node_id} promoted on "
-                    f"{self.host}:{self.port} at seq {self.acked_seq}")
+                    f"{self.host}:{self.port} at seq {acked}")
         self._log(f"promoted: serving epoch "
-                  f"{self.tracker._epoch} with "
-                  f"{len(self.tracker._ranks)} known ranks")
+                  f"{tr._epoch} with "
+                  f"{len(tr._ranks)} known ranks")
 
 
 # ------------------------------------------------------------- CI smoke

@@ -600,19 +600,23 @@ class Tracker:
             self._repl_cv.notify_all()  # unblock repl streamers
         if self._metrics_server is not None:
             self._metrics_server.stop()
-            self._metrics_server = None
+            # main-thread lifecycle handoff; serving threads are gone
+            self._metrics_server = None  # noqa: C003
         try:
             self.sock.close()
         except OSError:
             pass
         # workers have exited (or been killed) by now, so no live client
-        # can be poisoned by its service going away
-        for _epoch, svc in self._services:
+        # can be poisoned by its service going away; snapshot under the
+        # lock, shut down outside it (shutdown() can block on joins)
+        with self._lock:
+            services = list(self._services)
+            self._services.clear()
+        for _epoch, svc in services:
             try:
                 svc.shutdown()
             except Exception:
                 pass
-        self._services.clear()
         if self._wal_log is not None and not self.crashed:
             self._wal_log.close()
 
@@ -624,14 +628,16 @@ class Tracker:
         as the dead incarnation left it (every record was already
         fsynced on append), ready for a ``resume=True`` successor on
         the same pinned port."""
-        self.crashed = True
+        # happens-once flag flipped before the threads it gates are
+        # torn down; readers tolerate either value during the flip
+        self.crashed = True  # noqa: C003
         self._done.set()
         self._poll_stop.set()
         with self._repl_cv:
             self._repl_cv.notify_all()  # repl streamers die un-flushed
         if self._metrics_server is not None:
             self._metrics_server.stop()
-            self._metrics_server = None
+            self._metrics_server = None  # noqa: C003 - lifecycle teardown
         try:
             self.sock.close()
         except OSError:
@@ -733,7 +739,8 @@ class Tracker:
                              "node": self.node_id,
                              "promoted": bool(self.promoted)})
         try:
-            self._metrics_server = live.MetricsServer(
+            # poll thread starts only after this store completes
+            self._metrics_server = live.MetricsServer(  # noqa: C003
                 port=self._metrics_port,
                 sources_fn=self._metric_sources,
                 summary_fn=lambda: self.merged_metrics() or {},
@@ -913,16 +920,18 @@ class Tracker:
             with self._lock:
                 summaries = dict(self._metrics)
                 self._poll_count += 1
+                served_epoch = self._skew.get("epoch")
             strag = crossrank.straggler_snapshot(summaries)
             # raw per-sweep offsets fold through the ONE fleet-wide
             # election; the served digest is its smoothed, hysteretic
             # verdict with an epoch that bumps on election change
             raw = skew.digest_from_snapshot(strag)
             if self._skew_election is None:
-                self._skew_election = skew.FleetElection()
+                # poll thread is the sole writer after _replay seeding
+                self._skew_election = skew.FleetElection()  # noqa: C003
             digest = self._skew_election.fold(raw)
             if digest is not None and \
-                    digest.get("epoch") != self._skew.get("epoch"):
+                    digest.get("epoch") != served_epoch:
                 # journal VERDICTS, not sweeps: the digest's epoch
                 # bumps exactly when the election changes, so the WAL
                 # grows with decisions rather than with poll cadence

@@ -269,9 +269,10 @@ class WriteAheadLog:
                 os.fsync(f.fileno())
             os.replace(tmp, self.path)
             _fsync_dir(self.root)
-            self._fh = open(self.path, "ab")
-            self._seq = 0
-            self.records_total = 0
+            with self._lock:
+                self._fh = open(self.path, "ab")
+                self._seq = 0
+                self.records_total = 0
             return []
         records, end = self._scan()
         size = os.path.getsize(self.path)
@@ -281,9 +282,10 @@ class WriteAheadLog:
             # intact transition
             self.truncated_bytes = size - end
             os.truncate(self.path, end)
-        self._fh = open(self.path, "ab")
-        self._seq = len(records)
-        self.records_total = len(records)
+        with self._lock:
+            self._fh = open(self.path, "ab")
+            self._seq = len(records)
+            self.records_total = len(records)
         return records
 
     def close(self) -> None:
@@ -335,7 +337,8 @@ class WriteAheadLog:
     @property
     def seq(self) -> int:
         """Sequence number of the newest durable record (0 = empty)."""
-        return self._seq
+        with self._lock:
+            return self._seq
 
     # -- replay -----------------------------------------------------------
     def replay(self) -> List[Tuple[str, dict]]:

@@ -1,0 +1,39 @@
+"""C001 fixture: guarded-attribute accesses outside their lock."""
+import threading
+
+
+class Cache:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._entries = {}      # guarded-by: _lock
+        self._hits = 0          # guarded-by: _lock
+        self._waiters = 0       # guarded-by: _cv
+
+    def get(self, key):
+        # disciplined: both guarded attrs under the lock
+        with self._lock:
+            self._hits += 1
+            return self._entries.get(key)
+
+    def wait_get(self, key):
+        # disciplined via the alias: _cv wraps _lock, so holding _cv
+        # satisfies _lock-guarded attrs too
+        with self._cv:
+            self._waiters += 1
+            return self._entries.get(key)
+
+    def peek(self, key):
+        return self._entries.get(key)  # expect: C001
+
+    def reset(self):
+        self._hits = 0  # expect: C001
+        with self._lock:
+            self._entries.clear()
+
+    def racy_size(self):
+        return len(self._entries)  # noqa: C001 - fixture: justified read
+
+    def _evict_locked(self, key):
+        # caller-holds-lock convention: trusted, no finding
+        self._entries.pop(key, None)

@@ -1,0 +1,37 @@
+"""C002 fixture: the PR-12 lock-order inversion shape.
+
+``Replicator.publish`` journals while holding the replication
+condition (edge ``_repl_cv -> _wal_lock``, through the module-function
+call); ``Replicator.compact`` notifies replication while holding the
+journal lock (edge ``_wal_lock -> _repl_cv``). Two threads on the two
+paths deadlock — the analyzer must report the cycle.
+"""
+import threading
+
+_wal_lock = threading.Lock()
+_journal = []
+
+
+def wal_append(rec):
+    with _wal_lock:
+        _journal.append(rec)
+
+
+class Replicator:
+    def __init__(self):
+        self._repl_cv = threading.Condition()
+        self._log = []          # guarded-by: _repl_cv
+
+    def publish(self, rec):
+        # broadcast path: journal under the replication condition
+        with self._repl_cv:
+            self._log.append(rec)
+            wal_append(rec)
+            self._repl_cv.notify_all()
+
+    def compact(self):
+        # compaction path: replication state under the journal lock —
+        # the reverse acquisition order
+        with _wal_lock:
+            with self._repl_cv:
+                self._log.clear()

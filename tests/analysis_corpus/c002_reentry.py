@@ -1,0 +1,31 @@
+"""C002 fixture: self-deadlock on a non-reentrant lock, next to the
+same shape on an RLock (legal, must stay silent)."""
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.open_count = 0     # guarded-by: _lock
+
+    def enter(self):
+        with self._lock:
+            self._bump()        # re-acquires _lock: guaranteed hang
+
+    def _bump(self):
+        with self._lock:
+            self.open_count += 1
+
+
+class ReentrantGate:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.open_count = 0     # guarded-by: _lock
+
+    def enter(self):
+        with self._lock:
+            self._bump()        # fine: RLock reentry
+
+    def _bump(self):
+        with self._lock:
+            self.open_count += 1

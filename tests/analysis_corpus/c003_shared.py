@@ -1,0 +1,22 @@
+"""C003 fixture: unguarded cross-thread mutation in a class that
+spawns a thread."""
+import threading
+
+
+class Poller:
+    def __init__(self):
+        self.polls = 0
+        self.last_error = None
+        self._thread = None
+
+    def start(self):
+        # storing a fresh Thread is exempt (not shared mutable state)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        self.polls += 1  # expect: C003
+        self.last_error = "boom"  # noqa: C003 - fixture: single writer
+
+    def snapshot(self):
+        return (self.polls, self.last_error)

@@ -1,0 +1,21 @@
+"""Clean fixture: disciplined locking — every rule must stay silent."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.total = 0          # guarded-by: _lock
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        with self._lock:
+            self.total += 1
+
+    def snapshot(self):
+        with self._lock:
+            return self.total

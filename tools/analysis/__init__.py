@@ -1,0 +1,26 @@
+"""Pluggable static-analysis framework (see doc/static_analysis.md).
+
+Importing the package registers every rule module with the framework
+registry in :mod:`.core`; ``tools/lint.py`` is the CLI shim."""
+
+from . import core  # noqa: F401 - re-exported module handle
+from .core import (  # noqa: F401 - public re-exports
+    BASELINE_PATH,
+    DEFAULT_ROOTS,
+    REPO,
+    RULES,
+    FileContext,
+    check_file,
+    iter_py_files,
+    load_baseline,
+    main,
+    run_paths,
+    write_baseline,
+)
+
+# rule modules register themselves via the @rule decorator on import
+from . import rules_style    # noqa: F401  E999 F401 W191 W291
+from . import rules_telemetry  # noqa: F401  T001 T002 T003
+from . import rules_repo     # noqa: F401  R001 R002 R003 R004
+from . import rules_docs     # noqa: F401  R005 R006
+from . import locks          # noqa: F401  C001 C002 C003

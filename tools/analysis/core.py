@@ -1,0 +1,329 @@
+"""Rule framework for the repo's static-analysis plane.
+
+One shared AST parse per file, a registry of rules with per-rule
+metadata (``code``, ``tier``, ``explain``), uniform ``# noqa: CODE``
+handling, and a committed baseline file so a new rule can land without
+a flag-day. ``tools/lint.py`` is a thin shim over :func:`main`; the
+rule catalog lives in doc/static_analysis.md.
+
+Two rule scopes:
+
+- **file** rules receive one :class:`FileContext` and return findings
+  for that file (syntax, style, per-file contracts).
+- **repo** rules receive the full list of contexts after every file is
+  parsed and may correlate across files (the lock-acquisition graph
+  C002, knob/doc drift R005, wire-protocol coverage R006).
+
+Two tiers:
+
+- **error** findings fail the run (exit 1).
+- **warn** findings are printed with a ``warning:`` marker and never
+  affect the exit code — the tier for heuristics (C003) whose false
+  positives must not gate CI.
+
+Suppression, in precedence order:
+
+1. ``# noqa`` (blanket) or ``# noqa: CODE[,CODE]`` on the flagged line
+   suppresses any rule. Rules may additionally honor statement spans
+   (F401 maps a marker anywhere in a multi-line import onto the whole
+   statement).
+2. The committed baseline (``tools/analysis/baseline.txt``) suppresses
+   findings by ``code<TAB>path<TAB>message`` fingerprint — deliberately
+   line-number-free so unrelated edits don't invalidate entries.
+   C002 findings (lock-order cycles) are NEVER baselined: a potential
+   deadlock is fixed, not grandfathered.
+
+The analyzer never imports repo code — AST and text only — so a broken
+module cannot break the linter that is supposed to flag it.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import sys
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+DEFAULT_ROOTS = ("rabit_tpu", "tools", "tests", "examples", "bench.py",
+                 "setup.py")
+# analysis_corpus holds deliberately broken fixtures for the test
+# battery — the default walk must never scan them
+SKIP_DIRS = {"build", "__pycache__", ".git", "native", ".eggs",
+             "analysis_corpus"}
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.txt")
+# lock-order cycles are never baselined (see module docstring)
+NEVER_BASELINED = {"C002"}
+
+Finding = Tuple[str, int, str, str]          # (rel, line, code, message)
+
+
+class Rule:
+    __slots__ = ("code", "tier", "explain", "scope", "fn")
+
+    def __init__(self, code: str, tier: str, explain: str, scope: str,
+                 fn: Callable):
+        self.code = code
+        self.tier = tier
+        self.explain = explain
+        self.scope = scope
+        self.fn = fn
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(code: str, *, tier: str = "error", explain: str,
+         scope: str = "file"):
+    """Register a rule. ``scope='file'`` functions take a FileContext;
+    ``scope='repo'`` functions take the list of every FileContext."""
+    assert tier in ("error", "warn"), tier
+    assert scope in ("file", "repo"), scope
+
+    def deco(fn):
+        assert code not in RULES, f"duplicate rule {code}"
+        RULES[code] = Rule(code, tier, explain, scope, fn)
+        return fn
+    return deco
+
+
+class FileContext:
+    """One parsed file: path, source, line list, AST (None on syntax
+    error), and the per-line noqa map."""
+
+    __slots__ = ("path", "rel", "src", "lines", "tree", "noqa")
+
+    def __init__(self, path: str, src: str):
+        self.path = path
+        self.rel = os.path.relpath(path, REPO)
+        self.src = src
+        self.lines = src.splitlines()
+        try:
+            self.tree = ast.parse(src, filename=self.rel)
+        except SyntaxError:
+            self.tree = None
+        self.noqa = _parse_noqa(src)
+
+    def suppressed(self, line: int, code: str) -> bool:
+        """True when ``# noqa`` on ``line`` covers ``code``."""
+        codes = self.noqa.get(line, _MISSING)
+        if codes is _MISSING:
+            return False
+        return codes is None or code in codes
+
+
+_MISSING = object()
+
+
+def _parse_noqa(src: str) -> Dict[int, Optional[set]]:
+    """lineno -> None (blanket ``# noqa``) or the set of codes named in
+    ``# noqa: A,B``. Codes are matched case-sensitively, ruff-style."""
+    out: Dict[int, Optional[set]] = {}
+    for i, line in enumerate(src.splitlines(), 1):
+        if "# noqa" not in line:
+            continue
+        tail = line.split("# noqa", 1)[1]
+        if not tail.strip().startswith(":"):
+            out[i] = None            # blanket
+            continue
+        spec = tail.strip()[1:]
+        # "R001 - reason" / "R001, C003" — codes end at whitespace
+        # that isn't a separator
+        codes = set()
+        for chunk in spec.replace(",", " ").split():
+            if chunk.isalnum():
+                codes.add(chunk)
+            else:
+                break
+        out[i] = codes or None
+    return out
+
+
+def iter_py_files(paths: Sequence[str]):
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(REPO, p)
+        if os.path.isfile(full) and full.endswith(".py"):
+            yield full
+        elif os.path.isdir(full):
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in sorted(dirnames)
+                               if d not in SKIP_DIRS]
+                for f in sorted(filenames):
+                    if f.endswith(".py"):
+                        yield os.path.join(dirpath, f)
+
+
+# -------------------------------------------------------------- baseline
+
+def load_baseline(path: str = BASELINE_PATH) -> set:
+    """Fingerprints from the committed baseline: ``code\\tpath\\tmsg``
+    lines; '#' comments and blanks ignored. C002 entries are rejected
+    loudly rather than honored."""
+    entries = set()
+    try:
+        with open(path, encoding="utf-8") as f:
+            raw = f.read()
+    except OSError:
+        return entries
+    for ln, line in enumerate(raw.splitlines(), 1):
+        line = line.rstrip()
+        if not line or line.startswith("#"):
+            continue
+        parts = line.split("\t")
+        if len(parts) != 3:
+            raise ValueError(f"{path}:{ln}: malformed baseline entry "
+                             f"(want code<TAB>path<TAB>message)")
+        if parts[0] in NEVER_BASELINED:
+            raise ValueError(f"{path}:{ln}: {parts[0]} findings are "
+                             "never baselined — fix the cycle")
+        entries.add((parts[0], parts[1], parts[2]))
+    return entries
+
+
+def _fingerprint(f: Finding) -> Tuple[str, str, str]:
+    rel, _line, code, msg = f
+    return (code, rel.replace(os.sep, "/"), msg)
+
+
+def write_baseline(findings: List[Finding],
+                   path: str = BASELINE_PATH) -> int:
+    """Persist every non-C002 error-tier-or-warn finding as a baseline
+    entry; returns the entry count."""
+    keep = sorted({_fingerprint(f) for f in findings
+                   if f[2] not in NEVER_BASELINED})
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("# Static-analysis baseline (tools/analysis/core.py).\n"
+                "# One pre-existing finding per line: "
+                "code<TAB>path<TAB>message.\n"
+                "# Line numbers are deliberately omitted so unrelated "
+                "edits keep entries valid.\n"
+                "# C002 (lock-order cycle) entries are rejected at "
+                "load: cycles get fixed, not grandfathered.\n"
+                "# Regenerate with: python tools/lint.py "
+                "--update-baseline\n")
+        for code, rel, msg in keep:
+            f.write(f"{code}\t{rel}\t{msg}\n")
+    return len(keep)
+
+
+# ---------------------------------------------------------------- runner
+
+def run_paths(paths: Sequence[str], *, with_repo_rules: bool = True,
+              codes: Optional[set] = None) -> List[Finding]:
+    """Run every registered rule over ``paths``. File rules see each
+    file; repo rules see all of them together (only when
+    ``with_repo_rules``). noqa is applied here, uniformly; the
+    baseline is NOT (callers decide — see :func:`main`)."""
+    contexts = [FileContext(p, _read(p)) for p in iter_py_files(paths)]
+    findings: List[Finding] = []
+    for ctx in contexts:
+        for r in RULES.values():
+            if r.scope != "file":
+                continue
+            if codes is not None and r.code not in codes:
+                continue
+            findings.extend(r.fn(ctx))
+    if with_repo_rules:
+        for r in RULES.values():
+            if r.scope != "repo":
+                continue
+            if codes is not None and r.code not in codes:
+                continue
+            findings.extend(r.fn(contexts))
+    by_rel = {c.rel: c for c in contexts}
+    out = []
+    for f in findings:
+        ctx = by_rel.get(f[0])
+        if ctx is not None and ctx.suppressed(f[1], f[2]):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f[0], f[1], f[2]))
+    return out, len(contexts)
+
+
+def _read(path: str) -> str:
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def check_file(path: str) -> List[Finding]:
+    """Single-file entry point (kept for tests and muscle memory):
+    every file-scope rule, noqa applied, no repo rules, no baseline."""
+    findings, _ = run_paths([path], with_repo_rules=False)
+    return findings
+
+
+def _explain(code: str) -> int:
+    r = RULES.get(code)
+    if r is None:
+        print(f"unknown rule {code!r}; known: "
+              f"{', '.join(sorted(RULES))}", file=sys.stderr)
+        return 2
+    print(f"{r.code} [{r.tier}] ({r.scope}-scope)\n")
+    print(r.explain.strip())
+    return 0
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    update_baseline = "--update-baseline" in argv
+    no_baseline = "--no-baseline" in argv
+    argv = [a for a in argv
+            if a not in ("--json", "--update-baseline", "--no-baseline")]
+    if "--explain" in argv:
+        i = argv.index("--explain")
+        if i + 1 >= len(argv):
+            print("usage: --explain CODE", file=sys.stderr)
+            return 2
+        return _explain(argv[i + 1])
+    paths = argv or list(DEFAULT_ROOTS)
+    # repo-scope rules correlate across the whole tree; when the caller
+    # narrows to specific files they still run, over just those files,
+    # except the doc-drift rules which only make sense repo-wide
+    full_run = not argv
+    findings, n_files = run_paths(paths, with_repo_rules=full_run)
+    if update_baseline:
+        n = write_baseline(findings)
+        print(f"baseline: {n} entr{'y' if n == 1 else 'ies'} written to "
+              f"{os.path.relpath(BASELINE_PATH, REPO)}")
+        return 0
+    baseline = set() if no_baseline else load_baseline()
+    kept, suppressed = [], 0
+    for f in findings:
+        if _fingerprint(f) in baseline:
+            suppressed += 1
+            continue
+        kept.append(f)
+    errors = [f for f in kept if RULES[f[2]].tier == "error"]
+    warns = [f for f in kept if RULES[f[2]].tier == "warn"]
+    if as_json:
+        print(json.dumps({
+            "files": n_files,
+            "findings": [
+                {"path": rel.replace(os.sep, "/"), "line": line,
+                 "code": code, "tier": RULES[code].tier, "message": msg}
+                for rel, line, code, msg in kept],
+            "baselined": suppressed,
+        }, indent=2))
+        return 1 if errors else 0
+    for rel, line, code, msg in errors:
+        print(f"{rel}:{line}: {code} {msg}")
+    for rel, line, code, msg in warns:
+        print(f"{rel}:{line}: warning: {code} {msg}")
+    tail = f" ({suppressed} baselined)" if suppressed else ""
+    if errors:
+        print(f"{len(errors)} issue(s) in {n_files} file(s)"
+              f"{', ' + str(len(warns)) + ' warning(s)' if warns else ''}"
+              f"{tail}")
+        return 1
+    if warns:
+        print(f"lint clean ({n_files} files, {len(warns)} warning(s)"
+              f"{tail})")
+        return 0
+    print(f"lint clean ({n_files} files{tail})")
+    return 0

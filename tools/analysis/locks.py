@@ -1,0 +1,664 @@
+"""Lock-discipline analyzer: rule family C001–C003.
+
+The runtime is ~a dozen cooperating threads per process (tracker serve
++ per-connection handlers, poll/lease/replication loops, the watchdog
+ladder, skew poller, live-metrics daemons, the async dispatch plane),
+and the highest-severity bug of the last two PRs was a lock-ordering
+race in ``Tracker._wal()`` caught only by human review. These rules
+make the locking discipline checkable:
+
+- **C001** (error): a read/write of a *guarded* attribute outside a
+  ``with self.<guard>:`` scope or a ``*_locked`` helper. Guarded
+  attributes come from two sources: a trailing ``# guarded-by: _lock``
+  comment on the attribute-init line, and the seed registry below for
+  the known hot classes. Aliased guards (``self._cv =
+  threading.Condition(self._lock)``) are recognized automatically.
+- **C002** (error, repo scope): the whole-repo lock-acquisition graph
+  must be acyclic. An edge A→B is recorded when code acquires B while
+  (lexically) holding A — directly, through a same-class method call,
+  through a same-module function call, or through a method on an
+  attribute whose class is known (seed ``attr_types``). A cycle is a
+  potential lock-order inversion — the ``_repl_cv``-vs-WAL-internal-
+  lock shape from PR 12. Never baselined.
+- **C003** (warn): a class that spawns a ``threading.Thread`` mutates
+  an unguarded ``self.`` attribute outside ``__init__`` and outside
+  any lock, and that attribute is also touched by another method —
+  cross-thread shared state with no discipline. Heuristic tier:
+  justify deliberate single-writer designs with ``# noqa: C003``.
+
+Annotation syntax (doc/static_analysis.md):
+
+    self._ranks = {}            # guarded-by: _lock
+    self._repl_log = []         # guarded-by: _repl_cv
+    self._digest = None         # guarded-by: _lock,_mu   (aliases)
+
+A method named ``*_locked`` asserts "caller holds the class's locks";
+C001 trusts it (and flags callers that don't — via the guarded
+attributes such helpers touch at their call sites' own accesses).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import rule
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z0-9_,\s|]+)")
+
+# Seed registry for the known hot classes: class name -> spec.
+#   guarded:    attr -> guard attribute that must be held
+#   exempt:     methods that run before/without concurrency by
+#               construction (constructor-only paths, WAL replay)
+#   attr_types: attr -> class name, for cross-class lock-graph edges
+SEED_REGISTRY: Dict[str, dict] = {
+    "Tracker": {
+        "guarded": {
+            # registration / membership / world state — _lock (== _cv)
+            "_ranks": "_lock", "_pending": "_lock", "_epoch": "_lock",
+            "_shutdown_ranks": "_lock", "_metrics": "_lock",
+            "_endpoints": "_lock", "_endpoint_misses": "_lock",
+            "_topo": "_lock", "_skew": "_lock", "_lease": "_lock",
+            "_services": "_lock", "_last_straggler": "_lock",
+            "_poll_count": "_lock", "_resumed_ranks": "_lock",
+            # replication plane — its own condition (leaf toward WAL)
+            "_repl_log": "_repl_cv", "_repl_subs": "_repl_cv",
+            "_repl_hb": "_repl_cv", "_repl_hb_n": "_repl_cv",
+            "_journaled_lease": "_repl_cv",
+        },
+        # constructor-only paths: run before the serve thread exists
+        "exempt": {"_replay", "_note_resume"},
+        "attr_types": {"_wal_log": "WriteAheadLog"},
+    },
+    "StandbyTracker": {
+        "guarded": {
+            "_lease": "_mu", "_lease_deadline": "_mu",
+            "acked_seq": "_mu", "resyncs": "_mu",
+            "tracker": "_mu", "promoted_at": "_mu",
+        },
+        "attr_types": {"_wal": "WriteAheadLog"},
+    },
+    "WriteAheadLog": {
+        "guarded": {"_fh": "_lock", "_seq": "_lock",
+                    "records_total": "_lock"},
+        # open() runs once before any concurrent writer exists, but it
+        # takes the lock anyway — cheap, and keeps the discipline
+        # uniform; nothing exempt here.
+    },
+    "SkewMonitor": {
+        "guarded": {"_digest": "_lock", "_forced_raw": "_lock",
+                    "_applied": "_lock", "_synced": "_lock",
+                    "_misses": "_lock", "_poller": "_lock"},
+    },
+    "Watchdog": {
+        "guarded": {"_guards": "_lock", "_stop": "_lock",
+                    "expired_total": "_lock"},
+    },
+    "Recorder": {
+        "guarded": {"_spans": "_lock", "_head": "_lock",
+                    "_counters": "_lock", "_rounds": "_lock",
+                    "recorded": "_lock", "dropped": "_lock"},
+    },
+}
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_THREADY_CTORS = _LOCK_CTORS | {"Thread", "Event", "Semaphore",
+                                "BoundedSemaphore", "Barrier", "Timer"}
+
+
+class _Union:
+    """Tiny union-find over guard names (alias groups)."""
+
+    def __init__(self):
+        self.parent: Dict[str, str] = {}
+
+    def find(self, x: str) -> str:
+        self.parent.setdefault(x, x)
+        while self.parent[x] != x:
+            self.parent[x] = self.parent[self.parent[x]]
+            x = self.parent[x]
+        return x
+
+    def union(self, a: str, b: str) -> None:
+        ra, rb = self.find(a), self.find(b)
+        if ra != rb:
+            self.parent[ra] = rb
+
+
+def _call_ctor_name(value) -> Optional[str]:
+    """'Lock' for ``threading.Lock()`` / ``Lock()``; None otherwise."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _self_attr(node) -> Optional[str]:
+    """'X' for an ``self.X`` expression node."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class ClassModel:
+    """Everything C001/C002/C003 need to know about one class."""
+
+    def __init__(self, ctx, node: ast.ClassDef):
+        self.ctx = ctx
+        self.node = node
+        self.name = node.name
+        self.key = f"{ctx.rel}::{node.name}"
+        self.aliases = _Union()
+        self.locks: Dict[str, bool] = {}     # guard attr -> reentrant?
+        self.guarded: Dict[str, str] = {}    # attr -> guard attr
+        self.attr_types: Dict[str, str] = {}
+        self.exempt: Set[str] = set()
+        self.spawns_thread = False
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        seed = SEED_REGISTRY.get(node.name, {})
+        self.guarded.update(seed.get("guarded", {}))
+        self.exempt |= set(seed.get("exempt", ()))
+        self.attr_types.update(seed.get("attr_types", {}))
+        self._scan()
+
+    def _scan(self) -> None:
+        for item in self.node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods.setdefault(item.name, item)
+        for n in ast.walk(self.node):
+            if isinstance(n, ast.Call):
+                ctor = _call_ctor_name(n)
+                if ctor == "Thread":
+                    self.spawns_thread = True
+            if not isinstance(n, ast.Assign) or \
+                    not isinstance(n.value, ast.Call):
+                continue
+            ctor = _call_ctor_name(n.value)
+            if ctor not in _LOCK_CTORS:
+                continue
+            for t in n.targets:
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if ctor == "Lock":
+                    self.locks[attr] = False
+                elif ctor == "RLock":
+                    self.locks[attr] = True
+                else:  # Condition
+                    args = n.value.args
+                    wrapped = _self_attr(args[0]) if args else None
+                    if wrapped is not None:
+                        # Condition(self._x): same underlying lock
+                        self.aliases.union(attr, wrapped)
+                        self.locks[attr] = self.locks.get(wrapped, False)
+                    else:
+                        # bare Condition(): owns an RLock
+                        self.locks[attr] = True
+        # inline guarded-by declarations on attribute-init lines
+        for n in ast.walk(self.node):
+            if not isinstance(n, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = n.targets if isinstance(n, ast.Assign) else \
+                [n.target]
+            attrs = [a for a in map(_self_attr, targets) if a]
+            if not attrs:
+                continue
+            line = self.ctx.lines[n.lineno - 1] \
+                if n.lineno - 1 < len(self.ctx.lines) else ""
+            m = _GUARDED_BY_RE.search(line)
+            if not m:
+                continue
+            guards = [g for g in re.split(r"[,|\s]+", m.group(1).strip())
+                      if g]
+            if not guards:
+                continue
+            for g in guards[1:]:
+                self.aliases.union(guards[0], g)
+            for a in attrs:
+                self.guarded[a] = guards[0]
+
+    # -- guard-group helpers ----------------------------------------------
+    def group(self, guard: str) -> str:
+        return self.aliases.find(guard)
+
+    def guard_names(self) -> Set[str]:
+        out = set(self.locks)
+        out |= set(self.guarded.values())
+        return out
+
+    def reentrant(self, guard: str) -> bool:
+        root = self.group(guard)
+        for g, re_ok in self.locks.items():
+            if self.group(g) == root:
+                return re_ok
+        return False
+
+
+class ModuleModel:
+    """Module-level locks and functions participate in the lock graph
+    too (the async admission window's _INFLIGHT_LOCK, flight's events
+    lock, membership's identity lock)."""
+
+    def __init__(self, ctx):
+        self.ctx = ctx
+        self.key = f"{ctx.rel}::<module>"
+        self.locks: Dict[str, bool] = {}
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        self.classes: Dict[str, ClassModel] = {}
+        if ctx.tree is None:
+            return
+        for n in ctx.tree.body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(n.name, n)
+            elif isinstance(n, ast.ClassDef):
+                self.classes[n.name] = ClassModel(ctx, n)
+            elif isinstance(n, ast.Assign) and \
+                    isinstance(n.value, ast.Call):
+                ctor = _call_ctor_name(n.value)
+                if ctor in _LOCK_CTORS:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            self.locks[t.id] = ctor != "Lock"
+
+
+# ----------------------------------------------------------------- C001
+
+def _held_guards_from_with(items, cls: Optional[ClassModel],
+                           mod: ModuleModel) -> Set[str]:
+    """Group roots acquired by one With statement's items."""
+    out: Set[str] = set()
+    for item in items:
+        expr = item.context_expr
+        attr = _self_attr(expr)
+        if attr is not None and cls is not None and \
+                (attr in cls.locks or attr in cls.guard_names()):
+            out.add(("cls", cls.group(attr)))
+        elif isinstance(expr, ast.Name) and expr.id in mod.locks:
+            out.add(("mod", expr.id))
+    return out
+
+
+def _c001_method(cls: ClassModel, mod: ModuleModel, fn, findings):
+    guarded = cls.guarded
+    if not guarded:
+        return
+
+    def walk(node, held):
+        if isinstance(node, ast.With):
+            inner = held | _held_guards_from_with(node.items, cls, mod)
+            for item in node.items:
+                walk(item.context_expr, held)
+                if item.optional_vars:
+                    walk(item.optional_vars, held)
+            for child in node.body:
+                walk(child, inner)
+            return
+        attr = _self_attr(node)
+        if attr is not None and attr in guarded:
+            need = ("cls", cls.group(guarded[attr]))
+            if need not in held:
+                findings.append((
+                    cls.ctx.rel, node.lineno, "C001",
+                    f"'{cls.name}.{attr}' is guarded by "
+                    f"'{guarded[attr]}' but accessed outside it in "
+                    f"'{fn.name}' (hold `with self.{guarded[attr]}:`, "
+                    "use a *_locked helper, or justify with "
+                    "`# noqa: C001`)"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in fn.body:
+        walk(stmt, frozenset())
+
+
+@rule("C001", explain="""\
+Guarded-attribute access outside its lock. An attribute is *guarded*
+when its init line carries a trailing `# guarded-by: _lock` comment
+(aliases: `# guarded-by: _lock,_cv`) or when the class appears in the
+seed registry (tools/analysis/locks.py SEED_REGISTRY: Tracker,
+StandbyTracker, WriteAheadLog, SkewMonitor, Watchdog, Recorder). Every
+read or write of a guarded attribute must happen lexically inside
+`with self.<guard>:` (Condition aliases of the same lock count), or
+inside a method whose name ends in `_locked` (the caller-holds-lock
+convention), or inside __init__/__del__. Constructor-only helper paths
+can be exempted in the registry; deliberate lock-free reads get an
+inline `# noqa: C001` with a justification.""")
+def check_guarded_access(ctx):
+    if ctx.tree is None:
+        return []
+    mod = ModuleModel(ctx)
+    findings: List[Tuple] = []
+    for cls in mod.classes.values():
+        if not cls.guarded:
+            continue
+        for name, fn in cls.methods.items():
+            if name in ("__init__", "__del__") or name in cls.exempt \
+                    or name.endswith("_locked"):
+                continue
+            _c001_method(cls, mod, fn, findings)
+    return findings
+
+
+# ----------------------------------------------------------------- C002
+
+class _FnFacts:
+    """Per-function lock facts for the acquisition graph."""
+
+    __slots__ = ("direct", "calls", "edges", "pending")
+
+    def __init__(self):
+        self.direct: Set[tuple] = set()       # lock nodes acquired
+        self.calls: Set[tuple] = set()        # resolvable callees
+        self.edges: Set[tuple] = set()        # (lockA, lockB) direct
+        self.pending: Set[tuple] = set()      # (lockA, callee)
+
+
+def _lock_node(owner_key: str, cls: Optional[ClassModel],
+               guard: str, kind: str) -> tuple:
+    if kind == "cls":
+        root = cls.group(guard)
+        # name the node by the canonical guard attribute for stable,
+        # readable cycle reports
+        return (cls.key, root)
+    return (owner_key, guard)
+
+
+def _collect_fn_facts(fn, cls: Optional[ClassModel],
+                      mod: ModuleModel) -> _FnFacts:
+    facts = _FnFacts()
+
+    def callee_of(call) -> Optional[tuple]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in mod.functions:
+                return ("mod", f.id)
+            return None
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            attr = _self_attr(recv)
+            if attr is not None:      # self.X.m()
+                if cls is not None and attr in cls.attr_types:
+                    return ("typed", cls.attr_types[attr], f.attr)
+                return None
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                pass  # unreachable: _self_attr handled Attribute(self)
+            if isinstance(recv, ast.Name):
+                return None
+            return None
+        return None
+
+    def self_callee(call) -> Optional[tuple]:
+        f = call.func
+        if isinstance(f, ast.Attribute) and \
+                isinstance(f.value, ast.Name) and f.value.id == "self":
+            return ("self", f.attr)
+        return None
+
+    def walk(node, held):
+        if isinstance(node, ast.With):
+            acquired = set()
+            for item in node.items:
+                expr = item.context_expr
+                attr = _self_attr(expr)
+                if attr is not None and cls is not None and \
+                        (attr in cls.locks or attr in cls.guard_names()):
+                    acquired.add(_lock_node(mod.key, cls, attr, "cls"))
+                elif isinstance(expr, ast.Name) and expr.id in mod.locks:
+                    acquired.add(_lock_node(mod.key, None, expr.id,
+                                            "mod"))
+                walk(expr, held)
+            for ln in acquired:
+                facts.direct.add(ln)
+                for h in held:
+                    if h != ln:
+                        facts.edges.add((h, ln))
+                    else:
+                        facts.edges.add((h, ln))  # self-edge: reentry
+            inner = held | acquired
+            for child in node.body:
+                walk(child, inner)
+            return
+        if isinstance(node, ast.Call):
+            cal = self_callee(node) or callee_of(node)
+            if cal is not None:
+                facts.calls.add(cal)
+                for h in held:
+                    facts.pending.add((h, cal))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in fn.body:
+        walk(stmt, frozenset())
+    return facts
+
+
+@rule("C002", scope="repo", explain="""\
+Lock-order cycle (potential deadlock / lock-order inversion). The
+analyzer builds the whole-repo lock-acquisition graph: an edge A→B
+means some code path acquires lock B while lexically holding lock A —
+directly, via a same-class `self.method()` call, via a same-module
+function call, or via a method on an attribute whose class is declared
+in the seed registry's attr_types (e.g. `Tracker._wal_log` is a
+WriteAheadLog, so `self._wal_log.record(...)` under `_repl_cv`
+contributes `_repl_cv → WriteAheadLog._lock`). Any cycle — including a
+self-edge on a non-reentrant lock — is reported. This is exactly the
+`_repl_cv`-vs-WAL-internal-lock inversion shape from the PR 12 review.
+C002 findings are never baselined and not meaningfully noqa-able: fix
+the ordering (pick a global order; keep callee locks leaf-level).""")
+def check_lock_order(contexts):
+    mods = [ModuleModel(c) for c in contexts if c.tree is not None]
+    class_by_name: Dict[str, ClassModel] = {}
+    for m in mods:
+        for cname, cm in m.classes.items():
+            class_by_name.setdefault(cname, cm)
+
+    facts: Dict[tuple, _FnFacts] = {}
+    owner_of: Dict[tuple, tuple] = {}
+    for m in mods:
+        for fname, fn in m.functions.items():
+            key = ("mod", m.ctx.rel, fname)
+            facts[key] = _collect_fn_facts(fn, None, m)
+            owner_of[key] = (m, None)
+        for cm in m.classes.values():
+            for mname, fn in cm.methods.items():
+                key = ("cls", cm.key, mname)
+                facts[key] = _collect_fn_facts(fn, cm, m)
+                owner_of[key] = (m, cm)
+
+    def resolve(key: tuple, cal: tuple) -> Optional[tuple]:
+        m, cm = owner_of[key]
+        if cal[0] == "self" and cm is not None:
+            if cal[1] in cm.methods:
+                return ("cls", cm.key, cal[1])
+            return None
+        if cal[0] == "mod":
+            k = ("mod", m.ctx.rel, cal[1])
+            return k if k in facts else None
+        if cal[0] == "typed":
+            target = class_by_name.get(cal[1])
+            if target is not None and cal[2] in target.methods:
+                return ("cls", target.key, cal[2])
+            return None
+        return None
+
+    # transitive "locks acquired by calling this function" summaries
+    summary: Dict[tuple, Set[tuple]] = {
+        k: set(f.direct) for k, f in facts.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, f in facts.items():
+            for cal in f.calls:
+                tgt = resolve(key, cal)
+                if tgt is None:
+                    continue
+                before = len(summary[key])
+                summary[key] |= summary[tgt]
+                if len(summary[key]) != before:
+                    changed = True
+
+    edges: Set[tuple] = set()
+    for key, f in facts.items():
+        edges |= f.edges
+        for held, cal in f.pending:
+            tgt = resolve(key, cal)
+            if tgt is None:
+                continue
+            for ln in summary[tgt]:
+                edges.add((held, ln))
+
+    # reentrant self-edges are legal (RLock / bare Condition)
+    def is_reentrant(node: tuple) -> bool:
+        owner, guard = node
+        if owner.endswith("::<module>"):
+            for m in mods:
+                if m.key == owner:
+                    return m.locks.get(guard, False)
+            return False
+        for cname, cm in class_by_name.items():
+            if cm.key == owner:
+                return cm.reentrant(guard)
+        for m in mods:
+            for cm in m.classes.values():
+                if cm.key == owner:
+                    return cm.reentrant(guard)
+        return False
+
+    adj: Dict[tuple, Set[tuple]] = {}
+    findings = []
+    seen_cycles = set()
+    for a, b in sorted(edges):
+        if a == b:
+            if not is_reentrant(a):
+                label = _node_label(a)
+                cyc = (label,)
+                if cyc not in seen_cycles:
+                    seen_cycles.add(cyc)
+                    findings.append((
+                        a[0].split("::")[0], 1, "C002",
+                        f"non-reentrant lock {label} re-acquired while "
+                        "already held (guaranteed self-deadlock path)"))
+            continue
+        adj.setdefault(a, set()).add(b)
+
+    for cycle in _find_cycles(adj):
+        labels = tuple(_node_label(n) for n in cycle)
+        lo = min(range(len(labels)), key=lambda i: labels[i])
+        canon = labels[lo:] + labels[:lo]
+        if canon in seen_cycles:
+            continue
+        seen_cycles.add(canon)
+        findings.append((
+            cycle[lo][0].split("::")[0], 1, "C002",
+            "lock-order cycle: " + " -> ".join(canon + (canon[0],))
+            + " (lock-order inversion: establish one global "
+            "acquisition order or keep the inner lock leaf-level)"))
+    return findings
+
+
+def _node_label(node: tuple) -> str:
+    owner, guard = node
+    rel, _, scope = owner.partition("::")
+    base = rel.replace("\\", "/").rsplit("/", 1)[-1]
+    base = base[:-3] if base.endswith(".py") else base
+    where = base if scope == "<module>" else scope
+    return f"{where}.{guard}"
+
+
+def _find_cycles(adj: Dict[tuple, Set[tuple]]) -> List[List[tuple]]:
+    """Elementary cycles via DFS (graphs here are tiny)."""
+    cycles = []
+    nodes = sorted(adj)
+
+    def dfs(start, node, path, on_path):
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                cycles.append(list(path))
+            elif nxt not in on_path and nxt > start:
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+    for start in nodes:
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+# ----------------------------------------------------------------- C003
+
+@rule("C003", tier="warn", explain="""\
+Cross-thread mutation of unguarded shared state. In any class that
+spawns a threading.Thread, an assignment to a `self.` attribute
+outside __init__ that (a) happens outside every `with <lock>:` block,
+(b) targets an attribute with no guarded-by declaration, and (c)
+touches an attribute that at least one *other* method also uses, is
+flagged as probably-shared state with no discipline. This is a
+heuristic (warn tier, never fails CI): single-writer designs and
+main-thread-only lifecycle flags are legitimate — document them with
+`# noqa: C003 - <why>` at the store, or declare a guard to promote the
+attribute into C001's error-tier enforcement.""")
+def check_unguarded_shared(ctx):
+    if ctx.tree is None:
+        return []
+    mod = ModuleModel(ctx)
+    findings = []
+    for cls in mod.classes.values():
+        if not cls.spawns_thread:
+            continue
+        # attr -> set of method names touching it (any access)
+        touched: Dict[str, Set[str]] = {}
+        for mname, fn in cls.methods.items():
+            for n in ast.walk(fn):
+                attr = _self_attr(n)
+                if attr is not None:
+                    touched.setdefault(attr, set()).add(mname)
+        for mname, fn in cls.methods.items():
+            if mname in ("__init__", "__del__") or mname in cls.exempt \
+                    or mname.endswith("_locked"):
+                continue
+            _c003_method(cls, mod, mname, fn, touched, findings)
+    return findings
+
+
+def _c003_method(cls, mod, mname, fn, touched, findings):
+    def walk(node, held):
+        if isinstance(node, ast.With):
+            inner = held or bool(
+                _held_guards_from_with(node.items, cls, mod))
+            for child in node.body:
+                walk(child, inner)
+            return
+        stores = []
+        if isinstance(node, ast.Assign):
+            stores = [(t, node.value) for t in node.targets]
+        elif isinstance(node, ast.AugAssign):
+            stores = [(node.target, None)]
+        for target, value in stores:
+            attr = _self_attr(target)
+            if attr is None or held:
+                continue
+            if attr in cls.guarded or attr in cls.locks:
+                continue
+            ctor = _call_ctor_name(value) if value is not None else None
+            if ctor in _THREADY_CTORS:
+                continue  # storing a fresh Thread/Event/Lock object
+            if len(touched.get(attr, ())) < 2:
+                continue  # method-private
+            findings.append((
+                cls.ctx.rel, node.lineno, "C003",
+                f"'{cls.name}.{mname}' mutates '{attr}' outside any "
+                "lock in a thread-spawning class — guard it, declare "
+                "`# guarded-by:`, or justify with `# noqa: C003`"))
+        for child in ast.iter_child_nodes(node):
+            walk(child, held)
+
+    for stmt in fn.body:
+        walk(stmt, False)

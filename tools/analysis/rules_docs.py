@@ -1,0 +1,315 @@
+"""Doc-drift rules R005/R006 (repo scope): configuration knobs vs
+doc/parameters.md, and the tracker wire protocol vs its client senders
+and the protocol table in doc/guide.md.
+
+Both rules correlate *all* parsed files plus the markdown docs, so they
+only run on full-tree invocations (``python tools/lint.py`` with no
+file arguments) — exactly the shape CI uses."""
+
+from __future__ import annotations
+
+import ast
+import glob
+import os
+import re
+from typing import Dict, List, Set, Tuple
+
+from .core import REPO, rule
+
+PARAMS_DOC = os.path.join("doc", "parameters.md")
+PROTOCOL_DOC = os.path.join("doc", "guide.md")
+TRACKER_FILE = os.path.join("rabit_tpu", "tracker", "tracker.py")
+CONFIG_FILE = os.path.join("rabit_tpu", "utils", "config.py")
+
+_KNOB_RE = re.compile(r"^(rabit|RABIT|dmlc|DMLC)_[A-Za-z0-9_]+$")
+_TICKED = re.compile(r"`([A-Za-z_][A-Za-z0-9_]*)`")
+# a knob mention may carry a value sketch inside the backticks
+# (`RABIT_SKEW_TRACKER=host:port`) — capture the identifier prefix
+_TICKED_KNOB = re.compile(r"`((?:rabit|RABIT|dmlc|DMLC)_[A-Za-z0-9_]+)")
+
+# Knob-shaped strings that are NOT operator-facing parameters: internal
+# wire/export plumbing a doc row would only confuse. Keep tiny.
+R005_INTERNAL = {
+    # standby failover address each worker receives (doc'd under
+    # rabit_tracker_standby's row as the export target)
+}
+
+
+def _read_text(rel: str) -> str:
+    try:
+        with open(os.path.join(REPO, rel), encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return ""
+
+
+def _documented_knobs() -> Set[str]:
+    """Every backticked (rabit|dmlc)_* identifier anywhere in
+    doc/parameters.md, lowercased. Prose mentions count: exported-env
+    names are documented inside their owning parameter's row."""
+    return {tok.lower()
+            for tok in _TICKED_KNOB.findall(_read_text(PARAMS_DOC))}
+
+
+def _env_const_map(tree) -> Dict[str, str]:
+    """Module-level ``NAME = "RABIT_X"`` constants, so environ reads
+    through a named constant still resolve to the knob."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not (isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)
+                and _KNOB_RE.match(node.value.value)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out[t.id] = node.value.value
+    return out
+
+
+def _knob_reads(ctx) -> List[Tuple[str, int]]:
+    """(knob, lineno) for every configuration read in one file:
+    ``cfg.get*("rabit_x")`` calls, ``os.environ.get("RABIT_X")`` /
+    ``os.getenv`` / ``os.environ["RABIT_X"]`` (directly or through a
+    module-level name constant)."""
+    if ctx.tree is None:
+        return []
+    consts = _env_const_map(ctx.tree)
+    out: List[Tuple[str, int]] = []
+
+    def _resolve(node):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value if _KNOB_RE.match(node.value) else None
+        if isinstance(node, ast.Name):
+            return consts.get(node.id)
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if name is None or not node.args:
+                continue
+            is_env = name == "getenv" or (
+                name == "get" and isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Attribute)
+                and f.value.attr == "environ")
+            is_cfg = name.startswith("get") and not is_env
+            if not (is_env or is_cfg):
+                continue
+            knob = _resolve(node.args[0])
+            if knob:
+                out.append((knob, node.lineno))
+        elif isinstance(node, ast.Subscript):
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr == "environ":
+                knob = _resolve(node.slice)
+                if knob:
+                    out.append((knob, node.lineno))
+    return out
+
+
+def _registered_env_vars(contexts) -> List[Tuple[str, int]]:
+    """Entries of utils/config.py's ENV_VARS registry — registered
+    knobs are operator surface even before anything reads them."""
+    for ctx in contexts:
+        if ctx.rel != CONFIG_FILE or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "ENV_VARS"
+                    for t in node.targets) and \
+                    isinstance(node.value, (ast.List, ast.Tuple)):
+                return [(e.value, e.lineno) for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                        and _KNOB_RE.match(e.value)]
+    return []
+
+
+@rule("R005", scope="repo", explain="""\
+Knob/doc drift: every configuration knob the code actually consults —
+a cfg.get*("rabit_x") call, an os.environ/os.getenv read of a
+RABIT_*/DMLC_* name (directly or through a module constant), or an
+entry in utils/config.py's ENV_VARS registry — must be documented in
+doc/parameters.md (a backticked mention anywhere in the file counts;
+exported-env aliases are documented inside their owning parameter's
+row). The reverse direction holds too: every parameter-table row's
+knob must still be consulted somewhere in rabit_tpu/, native/src/ or
+tools/ — a row for a knob nothing reads documents a lie. Internal
+wire-plumbing names can be listed in R005_INTERNAL with a comment.""")
+def check_knob_docs(contexts):
+    documented = _documented_knobs()
+    findings = []
+    seen: Set[str] = set()
+    reads: List[Tuple[str, str, int]] = []
+    for ctx in contexts:
+        if not ctx.rel.startswith("rabit_tpu" + os.sep):
+            continue
+        for knob, line in _knob_reads(ctx):
+            reads.append((ctx.rel, knob, line))
+    for knob, line in _registered_env_vars(contexts):
+        reads.append((CONFIG_FILE, knob, line))
+    for rel, knob, line in reads:
+        low = knob.lower()
+        if low in documented or knob in R005_INTERNAL or low in seen:
+            continue
+        seen.add(low)
+        findings.append((
+            rel, line, "R005",
+            f"configuration knob '{knob}' is read here but has no "
+            "doc/parameters.md mention — add a row (or an exported-env "
+            "note in its owning parameter's row)"))
+
+    # reverse: documented rows must be consulted somewhere
+    consulted = {k.lower() for _, k, _ in reads}
+    hay = []
+    for ctx in contexts:
+        hay.append(ctx.src)
+    for pat in ("native/src/*.cc", "native/src/*.h", "native/src/*.py"):
+        for p in glob.glob(os.path.join(REPO, pat)):
+            hay.append(_read_text(os.path.relpath(p, REPO)))
+    corpus = "\n".join(hay)
+    doc_src = _read_text(PARAMS_DOC)
+    for ln, line in enumerate(doc_src.splitlines(), 1):
+        if not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1] if line.count("|") >= 2 else ""
+        for tok in _TICKED.findall(first_cell):
+            if not _KNOB_RE.match(tok):
+                continue
+            low = tok.lower()
+            if low in consulted:
+                continue
+            # textual presence in any scanned source keeps the row
+            if re.search(re.escape(tok), corpus, re.IGNORECASE):
+                continue
+            findings.append((
+                PARAMS_DOC, ln, "R005",
+                f"documented parameter '{tok}' is consulted nowhere in "
+                "rabit_tpu/, native/src/ or tools/ — stale row?"))
+    return findings
+
+
+def _dispatched_commands(contexts) -> List[Tuple[str, int]]:
+    """Commands the tracker's per-connection ``_handle`` dispatches on:
+    ``cmd == "x"`` and ``cmd in ("a", "b")`` comparisons."""
+    out: List[Tuple[str, int]] = []
+    for ctx in contexts:
+        if ctx.rel != TRACKER_FILE or ctx.tree is None:
+            continue
+        handler = None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.FunctionDef) and node.name == "_handle":
+                handler = node
+                break
+        if handler is None:
+            return []
+        for node in ast.walk(handler):
+            if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+                continue
+            if not (isinstance(node.left, ast.Name)
+                    and node.left.id == "cmd"):
+                continue
+            op, comp = node.ops[0], node.comparators[0]
+            if isinstance(op, ast.Eq) and isinstance(comp, ast.Constant) \
+                    and isinstance(comp.value, str):
+                out.append((comp.value, node.lineno))
+            elif isinstance(op, ast.In) and \
+                    isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                for e in comp.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, str):
+                        out.append((e.value, node.lineno))
+    return out
+
+
+def _protocol_table_rows() -> Dict[str, int]:
+    """command -> doc line for rows of the "Tracker wire protocol"
+    table in doc/guide.md (first backticked token of each row after a
+    heading containing 'wire protocol', until the next heading)."""
+    rows: Dict[str, int] = {}
+    in_section = False
+    for ln, line in enumerate(_read_text(PROTOCOL_DOC).splitlines(), 1):
+        if line.startswith("#"):
+            in_section = "wire protocol" in line.lower()
+            continue
+        if not in_section or not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1] if line.count("|") >= 2 else ""
+        m = _TICKED.search(first_cell)
+        if m and m.group(1) not in rows:
+            rows[m.group(1)] = ln
+    return rows
+
+
+def _has_sender(command: str, contexts) -> bool:
+    """A client sender exists when the quoted command appears as a call
+    argument in any Python file outside tracker/tracker.py, or as a
+    string literal in the native client (comm.cc sends print/shutdown
+    and the registration commands)."""
+    for ctx in contexts:
+        if ctx.rel == TRACKER_FILE or ctx.tree is None:
+            continue
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Constant) and arg.value == command:
+                    return True
+                if isinstance(arg, (ast.Tuple, ast.List)):
+                    for e in arg.elts:
+                        if isinstance(e, ast.Constant) and \
+                                e.value == command:
+                            return True
+    for pat in ("native/src/*.cc", "native/src/*.h"):
+        for p in glob.glob(os.path.join(REPO, pat)):
+            if f'"{command}"' in _read_text(os.path.relpath(p, REPO)):
+                return True
+    return False
+
+
+@rule("R006", scope="repo", explain="""\
+Wire-protocol coverage: every command the tracker's _handle dispatcher
+accepts (the `cmd == "x"` / `cmd in (...)` arms in
+rabit_tpu/tracker/tracker.py) must have (a) at least one client-side
+sender — the quoted command passed as a call argument somewhere
+outside tracker.py, or a string literal in the native client — and
+(b) a row in the "Tracker wire protocol" table in doc/guide.md.
+Conversely, a table row for a command the dispatcher no longer accepts
+is flagged as stale. A dispatch arm with no sender is dead protocol; a
+sender with no doc row is an undocumented wire surface other
+implementations (the native client, the standby follower) must
+reverse-engineer.""")
+def check_wire_protocol(contexts):
+    dispatched = _dispatched_commands(contexts)
+    if not dispatched:
+        return [(TRACKER_FILE, 1, "R006",
+                 "cannot locate the _handle command dispatcher "
+                 "(update rules_docs._dispatched_commands)")]
+    rows = _protocol_table_rows()
+    findings = []
+    seen: Set[str] = set()
+    for command, line in dispatched:
+        if command in seen:
+            continue
+        seen.add(command)
+        if not _has_sender(command, contexts):
+            findings.append((
+                TRACKER_FILE, line, "R006",
+                f"tracker command '{command}' has no client sender "
+                "outside tracker.py — dead protocol arm?"))
+        if command not in rows:
+            findings.append((
+                TRACKER_FILE, line, "R006",
+                f"tracker command '{command}' missing from the "
+                f"\"Tracker wire protocol\" table in {PROTOCOL_DOC}"))
+    for command, ln in sorted(rows.items()):
+        if command not in seen:
+            findings.append((
+                PROTOCOL_DOC, ln, "R006",
+                f"protocol table documents '{command}' but the tracker "
+                "dispatcher has no such arm — stale row?"))
+    return findings

@@ -1,0 +1,218 @@
+"""Repo-contract rules R001–R004, ported unchanged from the lint
+monolith: retried control-plane sockets, epoch-reset hooks, WAL
+journaling of tracker state, and recovery-path provenance counters."""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import rule
+from .rules_telemetry import _COUNTER_CALL_NAMES, _calls_any
+
+# R001: files allowed to construct sockets directly. Listeners/servers
+# (which accept rather than connect), the retry module itself, and the
+# chaos injector (whose whole point is raw socket manipulation).
+R001_ALLOWED = {
+    os.path.join("rabit_tpu", "utils", "retry.py"),
+    os.path.join("rabit_tpu", "tracker", "tracker.py"),
+    os.path.join("rabit_tpu", "chaos", "proxy.py"),
+    os.path.join("rabit_tpu", "chaos", "__main__.py"),
+}
+
+_R001_CALLS = {"socket", "create_connection"}
+
+# R002: modules holding world-size-derived state. Each must expose an
+# ``epoch_reset(world)`` hook (module-level function or a method on any
+# class) that the engines call on every elastic registration-epoch
+# transition. Grown together with elastic membership: add a module here
+# the moment it caches anything keyed on the world size.
+R002_MODULES = (
+    os.path.join("rabit_tpu", "tracker", "membership.py"),
+    os.path.join("rabit_tpu", "telemetry", "skew.py"),
+    os.path.join("rabit_tpu", "parallel", "topology.py"),
+    os.path.join("rabit_tpu", "parallel", "dispatch.py"),
+    os.path.join("rabit_tpu", "engine", "xla.py"),
+    os.path.join("rabit_tpu", "engine", "native.py"),
+)
+
+_R002_HOOK = "epoch_reset"
+
+# R003: crash-recovery journaling (ISSUE 10). Attributes of the Tracker
+# that the WAL replays on --resume; mutating one (or driving a
+# membership transition) without a self._wal(...) call in the same
+# function means a resumed tracker forgets that state.
+R003_FILE = os.path.join("rabit_tpu", "tracker", "tracker.py")
+R003_STATE = {"_ranks", "_topo", "_skew", "_endpoints", "_epoch",
+              # leadership lease (ISSUE 12): the lease IS a journaled
+              # record — a lease mutation that skips the WAL is a
+              # leadership claim replication can never ship, i.e. a
+              # structural split-brain hole
+              "_lease"}
+_R003_MEMBER_MUTATORS = {"evict", "park", "formed"}
+_R003_EXEMPT_PREFIXES = ("_replay",)
+
+# R004: data-plane recovery paths (ISSUE 13 self-healing ladder). Every
+# function that re-enters a collective after a fault — the in-collective
+# retry, the watchdog rungs, the native counter drain, the in-process
+# resize — must record its provenance counter (telemetry.count /
+# record_span / record_dispatch) BEFORE/while re-entering, mirroring
+# T002: a recovery that leaves no counter is invisible to fleet tables
+# and makes "the run healed itself N times" unanswerable post-hoc.
+R004_RECOVERY = {
+    os.path.join("rabit_tpu", "engine", "dataplane.py"): {
+        "_invoke", "_form_world"},
+    os.path.join("rabit_tpu", "engine", "native.py"): {
+        "_rung_retry", "_rung_reform", "_drain_recovery_stats",
+        "epoch_reset"},
+    os.path.join("rabit_tpu", "utils", "watchdog.py"): {"_reform"},
+}
+
+
+@rule("R001", explain="""\
+Unretried control-plane sockets: raw socket.socket(...) /
+socket.create_connection(...) calls inside rabit_tpu/ must go through
+utils/retry.py (connect_with_retry) so transient tracker restarts and
+chaos blackout windows degrade into logged backoff instead of one-shot
+failures. Servers/acceptors and the fault injector itself are
+allowlisted (R001_ALLOWED); # noqa: R001 exempts a line.""")
+def check_raw_sockets(ctx):
+    if not ctx.rel.startswith("rabit_tpu" + os.sep) \
+            or ctx.rel in R001_ALLOWED or ctx.tree is None:
+        return []
+    issues = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _R001_CALLS
+                and isinstance(f.value, ast.Name)
+                and f.value.id == "socket"):
+            continue
+        issues.append((
+            ctx.rel, node.lineno, "R001",
+            f"raw socket.{f.attr}() in control-plane code — use "
+            "rabit_tpu.utils.retry.connect_with_retry (or add the file "
+            "to R001_ALLOWED if it is a server/injector)"))
+    return issues
+
+
+@rule("R002", explain="""\
+Epoch-reset hook presence: modules that hold world-size-derived state
+(the R002_MODULES list) must define an epoch_reset(world) function or
+method. Elastic membership (tracker/membership.py) resizes the live
+world, and any module that caches schedules, groupings, digests, or
+counters keyed on the old size silently corrupts the new world unless
+it exposes the hook the engines drive on every registration-epoch
+transition.""")
+def check_epoch_reset(ctx):
+    if ctx.rel not in R002_MODULES or ctx.tree is None:
+        return []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name == _R002_HOOK:
+            return []
+    return [(ctx.rel, 1, "R002",
+             f"module holds world-size-derived state but defines no "
+             f"'{_R002_HOOK}(world)' hook (see R002_MODULES; elastic "
+             "resizes call it on every registration-epoch transition)")]
+
+
+def _r003_mutations(fn_node):
+    """(lineno, description) for every journaled-state mutation inside
+    ``fn_node``: a store/augassign to a R003_STATE attribute, a
+    subscript store through one (``self._ranks[t] = r``), or a
+    membership-transition method call (any receiver — locals like
+    ``m = self._member`` must not hide one)."""
+    out = []
+
+    def _attr_store(target):
+        if isinstance(target, ast.Attribute) and target.attr in R003_STATE:
+            return target.attr
+        if isinstance(target, ast.Subscript) and \
+                isinstance(target.value, ast.Attribute) and \
+                target.value.attr in R003_STATE:
+            return target.value.attr
+        return None
+
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                name = _attr_store(t)
+                if name:
+                    out.append((node.lineno, f"store to {name}"))
+        elif isinstance(node, ast.AugAssign):
+            name = _attr_store(node.target)
+            if name:
+                out.append((node.lineno, f"store to {name}"))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _R003_MEMBER_MUTATORS:
+            out.append((node.lineno, f"membership .{node.func.attr}()"))
+    return out
+
+
+def _r003_issues(rel, tree):
+    """Kept callable with (rel, tree) — tests drive it directly."""
+    if rel != R003_FILE:
+        return []
+    issues = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name == "__init__" or \
+                node.name.startswith(_R003_EXEMPT_PREFIXES):
+            continue
+        muts = _r003_mutations(node)
+        if muts and not _calls_any(node, {"_wal"}):
+            line, what = muts[0]
+            issues.append((
+                rel, line, "R003",
+                f"'{node.name}' mutates journaled tracker state "
+                f"({what}) without a self._wal(...) call — a resumed "
+                "tracker would forget it (see tracker/wal.py)"))
+    return issues
+
+
+@rule("R003", explain="""\
+Unjournaled tracker-state mutation: the tracker's crash recovery
+replays a write-ahead log (tracker/wal.py), so any function in
+tracker/tracker.py that mutates journaled control-plane state (the
+R003_STATE attributes, or membership transitions via
+.evict()/.park()/.formed()) must also call self._wal(...) — a mutation
+that skips the journal is state a resumed tracker silently forgets.
+__init__ and replay-path functions (_replay*) are exempt: they ARE the
+recovery side.""")
+def check_wal_journaling(ctx):
+    if ctx.tree is None:
+        return []
+    return _r003_issues(ctx.rel, ctx.tree)
+
+
+@rule("R004", explain="""\
+Uncounted recovery paths: every data-plane recovery path (the
+R004_RECOVERY map — in-collective retry, the watchdog retry/reform
+rungs, link resurrection draining, in-process resize) must record its
+provenance counter before re-entering the collective, mirroring T002 —
+a run that silently healed itself N times is indistinguishable from a
+healthy one in fleet tables.""")
+def check_recovery_counters(ctx):
+    required = R004_RECOVERY.get(ctx.rel)
+    if not required or ctx.tree is None:
+        return []
+    issues = []
+    seen = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in required and node.name not in seen:
+            seen.add(node.name)
+            if not _calls_any(node, _COUNTER_CALL_NAMES):
+                issues.append((
+                    ctx.rel, node.lineno, "R004",
+                    f"recovery path '{node.name}' records no provenance "
+                    "counter before re-entering the collective"))
+    for name in sorted(required - seen):
+        issues.append((ctx.rel, 1, "R004",
+                       f"expected recovery path '{name}' not found "
+                       "(update R004_RECOVERY)"))
+    return issues

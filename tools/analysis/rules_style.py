@@ -1,0 +1,108 @@
+"""Style/correctness rules ported from the original tools/lint.py
+monolith: syntax (E999), unused imports (F401), trailing whitespace
+(W291), tabs in indentation (W191). Behavior-identical to the
+monolith; only the plumbing moved into the rule framework."""
+
+from __future__ import annotations
+
+import ast
+
+from .core import rule
+
+
+@rule("E999", explain="""\
+Every file must parse. A stale merge artifact or half-edited file
+fails here, before the test suite trips over an ImportError mid-run.
+Not suppressible in any useful way: fix the syntax.""")
+def check_syntax(ctx):
+    if ctx.tree is not None:
+        return []
+    try:
+        ast.parse(ctx.src, filename=ctx.rel)
+    except SyntaxError as e:
+        return [(ctx.rel, e.lineno or 0, "E999",
+                 f"syntax error: {e.msg}")]
+    return []
+
+
+@rule("W291", explain="""\
+Trailing whitespace — the diff-noise generator. Editors that strip it
+on save produce whitespace-only hunks in unrelated commits.""")
+def check_trailing_ws(ctx):
+    out = []
+    for i, line in enumerate(ctx.lines, 1):
+        body = line.rstrip("\n")
+        if body != body.rstrip():
+            out.append((ctx.rel, i, "W291", "trailing whitespace"))
+    return out
+
+
+@rule("W191", explain="""\
+Tab characters in indentation. The repo indents with spaces; a tab
+that slips in renders differently per editor and can change Python's
+idea of the indentation level.""")
+def check_tabs(ctx):
+    out = []
+    for i, line in enumerate(ctx.lines, 1):
+        stripped = line.rstrip("\n").lstrip(" ")
+        if stripped.startswith("\t"):
+            out.append((ctx.rel, i, "W191", "tab in indentation"))
+    return out
+
+
+class _Usage(ast.NodeVisitor):
+    """Names referenced anywhere in the module (Load/Del contexts plus
+    __all__ strings); the root of an attribute chain counts for
+    ``import a.b`` style bindings."""
+
+    def __init__(self):
+        self.used = set()
+
+    def visit_Name(self, node):
+        if not isinstance(node.ctx, ast.Store):
+            self.used.add(node.id)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if "__all__" in targets and isinstance(node.value,
+                                               (ast.List, ast.Tuple)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and \
+                        isinstance(elt.value, str):
+                    self.used.add(elt.value)
+        self.generic_visit(node)
+
+
+@rule("F401", explain="""\
+An import binding never referenced by name — dead dependencies and
+leftover refactor debris. Names listed in __all__ count as used;
+``from __future__`` imports are exempt. ``# noqa`` anywhere in a
+multi-line import statement's span exempts the whole statement
+(re-export blocks in __init__.py use this, same as under ruff).""")
+def check_unused_imports(ctx):
+    if ctx.tree is None:
+        return []
+    usage = _Usage()
+    usage.visit(ctx.tree)
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.Import, ast.ImportFrom)):
+            continue
+        # the noqa marker can sit on any line of a multi-line import;
+        # map it onto the statement via the node's line span
+        span = range(node.lineno, (node.end_lineno or node.lineno) + 1)
+        if any(ctx.suppressed(i, "F401") for i in span):
+            continue
+        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
+            continue
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            bound = alias.asname or alias.name.split(".")[0]
+            if bound not in usage.used:
+                shown = alias.name + (f" as {alias.asname}"
+                                      if alias.asname else "")
+                out.append((ctx.rel, node.lineno, "F401",
+                            f"'{shown}' imported but unused"))
+    return out

@@ -1,0 +1,200 @@
+"""Telemetry-contract rules (T001–T003), ported unchanged from the
+lint monolith: span presence on collective entry points, counter
+presence on escalation paths, and /metrics family registration."""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from .core import REPO, rule
+
+# Public collective entry points that must carry a telemetry span (or a
+# trace annotation): rel path -> required function names. Keep in sync
+# with doc/observability.md's instrumentation table.
+SPAN_REQUIRED = {
+    os.path.join("rabit_tpu", "parallel", "collectives.py"): {
+        "device_allreduce", "device_allreduce_tree", "device_broadcast",
+        "device_reduce_scatter", "device_allgather",
+        "device_hier_allreduce", "_per_shard_allreduce",
+        "preagg_allreduce", "device_allreduce_async",
+        "bucket_allreduce_async", "device_hier_allreduce_async",
+        "grad_bucket_allreduce_async"},
+    os.path.join("rabit_tpu", "engine", "base.py"): {
+        "reduce_scatter", "allgather"},
+    os.path.join("rabit_tpu", "engine", "xla.py"): {
+        "allreduce", "broadcast", "reduce_scatter", "allgather",
+        "allreduce_async"},
+    os.path.join("rabit_tpu", "engine", "native.py"): {
+        "allreduce", "broadcast"},
+    os.path.join("rabit_tpu", "engine", "dataplane.py"): {"_allreduce"},
+}
+
+_SPAN_CALL_NAMES = {"span", "trace_annotation"}
+
+# Failure escalation paths that must leave a telemetry counter behind:
+# rel path -> required function names. Keep in sync with
+# doc/observability.md's instrumentation table.
+COUNTER_REQUIRED = {
+    os.path.join("rabit_tpu", "utils", "watchdog.py"): {
+        "_escalate", "_abort"},
+    os.path.join("rabit_tpu", "chaos", "proxy.py"): {"_event"},
+}
+
+_COUNTER_CALL_NAMES = {"count", "record_span", "record_dispatch"}
+
+# T003: files that mint /metrics family names. Every name found here
+# (via _t003_minted_names) must be registered in prom.py's
+# METRIC_FAMILIES table.
+T003_SCAN = (
+    os.path.join("rabit_tpu", "telemetry", "prom.py"),
+    os.path.join("rabit_tpu", "telemetry", "live.py"),
+    os.path.join("rabit_tpu", "telemetry", "profile.py"),
+    os.path.join("rabit_tpu", "tracker", "tracker.py"),
+    os.path.join("rabit_tpu", "engine", "xla.py"),
+    os.path.join("rabit_tpu", "engine", "native.py"),
+    os.path.join("rabit_tpu", "telemetry", "skew.py"),
+)
+
+_T003_TYPES = {"counter", "gauge", "histogram"}
+
+
+def _calls_any(fn_node, call_names) -> bool:
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name in call_names:
+            return True
+    return False
+
+
+def _required_defs(ctx, required, code, kind, table_name):
+    """Shared T001/T002 shape: every function named in ``required``
+    must exist and must make one of the required calls."""
+    out = []
+    seen = set()
+    names = required[0]
+    calls = required[1]
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name in names and node.name not in seen:
+            seen.add(node.name)
+            if not _calls_any(node, calls):
+                out.append((ctx.rel, node.lineno, code,
+                            kind.format(name=node.name)))
+    for name in sorted(names - seen):
+        out.append((ctx.rel, 1, code,
+                    f"expected {table_name[0]} '{name}' not found "
+                    f"(update {table_name[1]})"))
+    return out
+
+
+@rule("T001", explain="""\
+Telemetry span presence: every public collective entry point (the
+SPAN_REQUIRED map) must contain a telemetry.span(...) or
+telemetry.trace_annotation(...) call. An uninstrumented hot path
+silently disappears from traces, fleet tables, and the dispatch
+accounting. Keep SPAN_REQUIRED in sync with doc/observability.md.""")
+def check_spans(ctx):
+    required = SPAN_REQUIRED.get(ctx.rel)
+    if not required or ctx.tree is None:
+        return []
+    return _required_defs(
+        ctx, (required, _SPAN_CALL_NAMES), "T001",
+        "collective entry point '{name}' has no telemetry "
+        "span/trace_annotation",
+        ("collective entry point", "SPAN_REQUIRED"))
+
+
+@rule("T002", explain="""\
+Escalation counter presence: failure escalation paths (the
+COUNTER_REQUIRED map — watchdog expiry/abort, chaos fault injection)
+must record a telemetry counter (telemetry.count / record_span /
+record_dispatch). An uncounted escalation is invisible to fleet
+tables, the live /metrics endpoints, and post-mortem flight
+bundles.""")
+def check_counters(ctx):
+    required = COUNTER_REQUIRED.get(ctx.rel)
+    if not required or ctx.tree is None:
+        return []
+    return _required_defs(
+        ctx, (required, _COUNTER_CALL_NAMES), "T002",
+        "escalation path '{name}' records no telemetry counter",
+        ("escalation path", "COUNTER_REQUIRED"))
+
+
+def _t003_registry():
+    """METRIC_FAMILIES entries parsed from prom.py's AST (never
+    imported — lint must not execute repo code)."""
+    path = os.path.join(REPO, "rabit_tpu", "telemetry", "prom.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "METRIC_FAMILIES"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return None
+
+
+def _t003_minted_names(tree):
+    """(name, lineno) for every family minted in this module: a
+    ``_Family("rabit_...", ...)`` construction, or a gauge-spec tuple
+    whose first element is a ``rabit_``-prefixed string and whose
+    third is a Prometheus type keyword."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            fname = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if fname == "_Family" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str) and \
+                    node.args[0].value.startswith("rabit_"):
+                out.append((node.args[0].value, node.lineno))
+        elif isinstance(node, ast.Tuple) and len(node.elts) >= 3:
+            head, third = node.elts[0], node.elts[2]
+            if isinstance(head, ast.Constant) and \
+                    isinstance(head.value, str) and \
+                    head.value.startswith("rabit_") and \
+                    isinstance(third, ast.Constant) and \
+                    third.value in _T003_TYPES:
+                out.append((head.value, node.lineno))
+    return out
+
+
+@rule("T003", explain="""\
+Metric-family registration: every /metrics family name minted anywhere
+in the telemetry/engine/tracker code (a _Family("rabit_...", ...)
+construction or a gauge-spec tuple ("rabit_...", help, type)) must
+appear in the METRIC_FAMILIES table in rabit_tpu/telemetry/prom.py —
+one place to see the full exposition surface, so a new family can't
+ship undocumented or collide with an existing name spelled slightly
+differently.""")
+def check_metric_families(ctx):
+    if ctx.rel not in T003_SCAN or ctx.tree is None:
+        return []
+    minted = _t003_minted_names(ctx.tree)
+    if not minted:
+        return []
+    registry = _t003_registry()
+    if registry is None:
+        return [(ctx.rel, 1, "T003",
+                 "cannot parse METRIC_FAMILIES from "
+                 "rabit_tpu/telemetry/prom.py")]
+    return [(ctx.rel, line, "T003",
+             f"metrics family '{name}' not registered in "
+             "METRIC_FAMILIES (rabit_tpu/telemetry/prom.py)")
+            for name, line in minted if name not in registry]

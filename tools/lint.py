@@ -1,548 +1,86 @@
-#!/usr/bin/env python
-"""Dependency-free lint tier for scripts/run_tests.sh.
+#!/usr/bin/env python3
+"""Repo lint — thin shim over the pluggable rule framework in
+``tools/analysis/`` (rule catalog and suppression policy:
+doc/static_analysis.md).
 
-The reference CI runs a lint pass before building (travis: make lint —
-dmlc-core's pylint wrapper); this repo's containers ship no linter, so
-this implements the highest-signal subset with only the stdlib:
+    python tools/lint.py                 # whole tree, all rules
+    python tools/lint.py a.py b.py       # specific files (file rules)
+    python tools/lint.py --explain C002  # what a rule means and why
+    python tools/lint.py --json          # machine-readable findings
+    python tools/lint.py --update-baseline
 
-- **syntax**: every file must parse (a stale merge artifact or
-  half-edited file fails here, not mid-suite).
-- **unused imports** (pyflakes F401): an import binding never referenced
-  by name — the check that catches dead dependencies and leftover
-  refactor debris. ``# noqa`` / ``# noqa: F401`` on the import line
-  exempts it (re-export blocks in ``__init__.py`` use this, same as
-  under ruff); names listed in ``__all__`` count as used.
-- **trailing whitespace** and **tabs in indentation** (W291/W191): the
-  diff-noise generators.
-- **telemetry span presence** (T001, repo-specific): every public
-  collective entry point (the SPAN_REQUIRED map) must contain a
-  ``telemetry.span(...)`` or ``telemetry.trace_annotation(...)`` call —
-  an uninstrumented hot path silently disappears from traces, fleet
-  tables, and the dispatch accounting.
-- **escalation counter presence** (T002, repo-specific): failure
-  escalation paths (the COUNTER_REQUIRED map — watchdog expiry/abort,
-  chaos fault injection) must record a telemetry counter
-  (``telemetry.count(...)`` / ``record_span`` / ``record_dispatch``) —
-  an uncounted escalation is invisible to fleet tables, the live
-  ``/metrics`` endpoints, and post-mortem flight bundles.
-- **metric-family registration** (T003, repo-specific): every
-  ``/metrics`` family name minted anywhere in the telemetry/engine/
-  tracker code (a ``_Family("rabit_...", ...)`` construction or a
-  gauge-spec tuple ``("rabit_...", help, "counter"|"gauge"|...)``)
-  must appear in the ``METRIC_FAMILIES`` table in
-  ``rabit_tpu/telemetry/prom.py`` — one place to see the full
-  exposition surface, so a new family can't ship undocumented or
-  collide with an existing name spelled slightly differently.
-- **unretried control-plane sockets** (R001, repo-specific): raw
-  ``socket.socket(...)`` / ``socket.create_connection(...)`` calls
-  inside ``rabit_tpu/`` must go through ``utils/retry.py``
-  (``connect_with_retry``) so transient tracker restarts and chaos
-  blackout windows degrade into logged backoff instead of one-shot
-  failures. Servers/acceptors and the fault injector itself are
-  allowlisted (R001_ALLOWED); ``# noqa: R001`` exempts a line.
-- **epoch-reset hook presence** (R002, repo-specific): modules that
-  hold world-size-derived state (the R002_MODULES list) must define an
-  ``epoch_reset(world)`` function or method — elastic membership
-  (``tracker/membership.py``) resizes the live world, and any module
-  that caches schedules, groupings, digests, or counters keyed on the
-  old size silently corrupts the new world unless it exposes the hook
-  the engines drive on every registration-epoch transition.
-- **unjournaled tracker-state mutation** (R003, repo-specific): the
-  tracker's crash recovery replays a write-ahead log
-  (``tracker/wal.py``), so any function in ``tracker/tracker.py`` that
-  mutates journaled control-plane state (the R003_STATE attributes, or
-  membership transitions via ``.evict()``/``.park()``/``.formed()``)
-  must also call ``self._wal(...)`` — a mutation that skips the
-  journal is state a resumed tracker silently forgets. ``__init__``
-  and replay-path functions (``_replay*``) are exempt: they *are* the
-  recovery side.
-- **uncounted recovery paths** (R004, repo-specific): every data-plane
-  recovery path (the R004_RECOVERY map — in-collective retry, the
-  watchdog retry/reform rungs, link resurrection draining, in-process
-  resize) must record its provenance counter before re-entering the
-  collective, mirroring T002 — a run that silently healed itself N
-  times is indistinguishable from a healthy one in fleet tables.
-
-``scripts/run_tests.sh`` prefers ``ruff check`` when installed; this is
-the fallback so the tier never silently no-ops. Exit 0 clean, 1 with
-findings (one ``path:line: code message`` per line, ruff-style).
-
-Usage: python tools/lint.py [paths...]   (default: the repo's tracked
-Python roots — rabit_tpu/ tools/ tests/ examples/ bench.py setup.py)
-"""
+Everything below re-exports the framework's public surface plus the
+legacy helper names the test suite drives directly; new rules go in
+``tools/analysis/``, not here."""
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DEFAULT_ROOTS = ("rabit_tpu", "tools", "tests", "examples", "bench.py",
-                 "setup.py")
-SKIP_DIRS = {"build", "__pycache__", ".git", "native", ".eggs"}
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
 
-# Public collective entry points that must carry a telemetry span (or a
-# trace annotation): rel path -> required function names. Keep in sync
-# with doc/observability.md's instrumentation table.
-SPAN_REQUIRED = {
-    os.path.join("rabit_tpu", "parallel", "collectives.py"): {
-        "device_allreduce", "device_allreduce_tree", "device_broadcast",
-        "device_reduce_scatter", "device_allgather",
-        "device_hier_allreduce", "_per_shard_allreduce",
-        "preagg_allreduce", "device_allreduce_async",
-        "bucket_allreduce_async", "device_hier_allreduce_async",
-        "grad_bucket_allreduce_async"},
-    os.path.join("rabit_tpu", "engine", "base.py"): {
-        "reduce_scatter", "allgather"},
-    os.path.join("rabit_tpu", "engine", "xla.py"): {
-        "allreduce", "broadcast", "reduce_scatter", "allgather",
-        "allreduce_async"},
-    os.path.join("rabit_tpu", "engine", "native.py"): {
-        "allreduce", "broadcast"},
-    os.path.join("rabit_tpu", "engine", "dataplane.py"): {"_allreduce"},
-}
-
-_SPAN_CALL_NAMES = {"span", "trace_annotation"}
-
-# Failure escalation paths that must leave a telemetry counter behind:
-# rel path -> required function names. Keep in sync with
-# doc/observability.md's instrumentation table.
-COUNTER_REQUIRED = {
-    os.path.join("rabit_tpu", "utils", "watchdog.py"): {
-        "_escalate", "_abort"},
-    os.path.join("rabit_tpu", "chaos", "proxy.py"): {"_event"},
-}
-
-_COUNTER_CALL_NAMES = {"count", "record_span", "record_dispatch"}
-
-# R004: data-plane recovery paths (ISSUE 13 self-healing ladder). Every
-# function that re-enters a collective after a fault — the in-collective
-# retry, the watchdog rungs, the native counter drain, the in-process
-# resize — must record its provenance counter (telemetry.count /
-# record_span / record_dispatch) BEFORE/while re-entering, mirroring
-# T002: a recovery that leaves no counter is invisible to fleet tables
-# and makes "the run healed itself N times" unanswerable post-hoc.
-R004_RECOVERY = {
-    os.path.join("rabit_tpu", "engine", "dataplane.py"): {
-        "_invoke", "_form_world"},
-    os.path.join("rabit_tpu", "engine", "native.py"): {
-        "_rung_retry", "_rung_reform", "_drain_recovery_stats",
-        "epoch_reset"},
-    os.path.join("rabit_tpu", "utils", "watchdog.py"): {"_reform"},
-}
-
-
-def _r004_issues(rel, tree):
-    required = R004_RECOVERY.get(rel)
-    if not required:
-        return []
-    issues = []
-    seen = set()
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name in required and node.name not in seen:
-            seen.add(node.name)
-            if not _calls_any(node, _COUNTER_CALL_NAMES):
-                issues.append((
-                    rel, node.lineno, "R004",
-                    f"recovery path '{node.name}' records no provenance "
-                    "counter before re-entering the collective"))
-    for name in sorted(required - seen):
-        issues.append((rel, 1, "R004",
-                       f"expected recovery path '{name}' not found "
-                       "(update R004_RECOVERY)"))
-    return issues
-
-
-# R001: files allowed to construct sockets directly. Listeners/servers
-# (which accept rather than connect), the retry module itself, and the
-# chaos injector (whose whole point is raw socket manipulation).
-R001_ALLOWED = {
-    os.path.join("rabit_tpu", "utils", "retry.py"),
-    os.path.join("rabit_tpu", "tracker", "tracker.py"),
-    os.path.join("rabit_tpu", "chaos", "proxy.py"),
-    os.path.join("rabit_tpu", "chaos", "__main__.py"),
-}
-
-_R001_CALLS = {"socket", "create_connection"}
-
-# R002: modules holding world-size-derived state. Each must expose an
-# ``epoch_reset(world)`` hook (module-level function or a method on any
-# class) that the engines call on every elastic registration-epoch
-# transition. Grown together with elastic membership: add a module here
-# the moment it caches anything keyed on the world size.
-R002_MODULES = (
-    os.path.join("rabit_tpu", "tracker", "membership.py"),
-    os.path.join("rabit_tpu", "telemetry", "skew.py"),
-    os.path.join("rabit_tpu", "parallel", "topology.py"),
-    os.path.join("rabit_tpu", "parallel", "dispatch.py"),
-    os.path.join("rabit_tpu", "engine", "xla.py"),
-    os.path.join("rabit_tpu", "engine", "native.py"),
+from analysis import (  # noqa: F401 - re-exported public surface
+    BASELINE_PATH,
+    DEFAULT_ROOTS,
+    REPO,
+    RULES,
+    FileContext,
+    check_file,
+    iter_py_files,
+    load_baseline,
+    main,
+    run_paths,
+    write_baseline,
+)
+from analysis.locks import SEED_REGISTRY  # noqa: F401
+from analysis.rules_repo import (  # noqa: F401
+    R001_ALLOWED,
+    R002_MODULES,
+    R003_FILE,
+    R003_STATE,
+    R004_RECOVERY,
+    _r003_issues,
+    check_raw_sockets,
+    check_recovery_counters,
+)
+from analysis.rules_telemetry import (  # noqa: F401
+    COUNTER_REQUIRED,
+    SPAN_REQUIRED,
+    T003_SCAN,
+    _t003_registry,
+    check_metric_families,
 )
 
-_R002_HOOK = "epoch_reset"
 
-# R003: crash-recovery journaling (ISSUE 10). Attributes of the Tracker
-# that the WAL replays on --resume; mutating one (or driving a
-# membership transition) without a self._wal(...) call in the same
-# function means a resumed tracker forgets that state.
-R003_FILE = os.path.join("rabit_tpu", "tracker", "tracker.py")
-R003_STATE = {"_ranks", "_topo", "_skew", "_endpoints", "_epoch",
-              # leadership lease (ISSUE 12): the lease IS a journaled
-              # record — a lease mutation that skips the WAL is a
-              # leadership claim replication can never ship, i.e. a
-              # structural split-brain hole
-              "_lease"}
-_R003_MEMBER_MUTATORS = {"evict", "park", "formed"}
-_R003_EXEMPT_PREFIXES = ("_replay",)
+class _Ctx:
+    """Minimal FileContext stand-in for the legacy (rel, tree[, src])
+    helper signatures the tests call."""
 
-# T003: files that mint /metrics family names. Every name found here
-# (via _t003_minted_names) must be registered in prom.py's
-# METRIC_FAMILIES table.
-T003_SCAN = (
-    os.path.join("rabit_tpu", "telemetry", "prom.py"),
-    os.path.join("rabit_tpu", "telemetry", "live.py"),
-    os.path.join("rabit_tpu", "telemetry", "profile.py"),
-    os.path.join("rabit_tpu", "tracker", "tracker.py"),
-    os.path.join("rabit_tpu", "engine", "xla.py"),
-    os.path.join("rabit_tpu", "engine", "native.py"),
-    os.path.join("rabit_tpu", "telemetry", "skew.py"),
-)
-
-_T003_TYPES = {"counter", "gauge", "histogram"}
-
-
-def _t003_registry():
-    """METRIC_FAMILIES entries parsed from prom.py's AST (never
-    imported — lint must not execute repo code)."""
-    path = os.path.join(REPO, "rabit_tpu", "telemetry", "prom.py")
-    try:
-        with open(path, encoding="utf-8") as f:
-            tree = ast.parse(f.read())
-    except (OSError, SyntaxError):
-        return None
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Assign):
-            continue
-        if not any(isinstance(t, ast.Name) and t.id == "METRIC_FAMILIES"
-                   for t in node.targets):
-            continue
-        if isinstance(node.value, (ast.Tuple, ast.List)):
-            return {e.value for e in node.value.elts
-                    if isinstance(e, ast.Constant)
-                    and isinstance(e.value, str)}
-    return None
-
-
-def _t003_minted_names(tree):
-    """(name, lineno) for every family minted in this module: a
-    ``_Family("rabit_...", ...)`` construction, or a gauge-spec tuple
-    whose first element is a ``rabit_``-prefixed string and whose
-    third is a Prometheus type keyword."""
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            f = node.func
-            fname = f.attr if isinstance(f, ast.Attribute) else (
-                f.id if isinstance(f, ast.Name) else None)
-            if fname == "_Family" and node.args and \
-                    isinstance(node.args[0], ast.Constant) and \
-                    isinstance(node.args[0].value, str) and \
-                    node.args[0].value.startswith("rabit_"):
-                out.append((node.args[0].value, node.lineno))
-        elif isinstance(node, ast.Tuple) and len(node.elts) >= 3:
-            head, third = node.elts[0], node.elts[2]
-            if isinstance(head, ast.Constant) and \
-                    isinstance(head.value, str) and \
-                    head.value.startswith("rabit_") and \
-                    isinstance(third, ast.Constant) and \
-                    third.value in _T003_TYPES:
-                out.append((head.value, node.lineno))
-    return out
-
-
-def _t003_issues(rel, tree):
-    if rel not in T003_SCAN:
-        return []
-    minted = _t003_minted_names(tree)
-    if not minted:
-        return []
-    registry = _t003_registry()
-    if registry is None:
-        return [(rel, 1, "T003",
-                 "cannot parse METRIC_FAMILIES from "
-                 "rabit_tpu/telemetry/prom.py")]
-    return [(rel, line, "T003",
-             f"metrics family '{name}' not registered in "
-             "METRIC_FAMILIES (rabit_tpu/telemetry/prom.py)")
-            for name, line in minted if name not in registry]
+    def __init__(self, rel, tree, src=""):
+        self.rel = rel
+        self.tree = tree
+        self.src = src
+        self.lines = src.splitlines()
 
 
 def _r001_issues(rel, tree, src):
-    """Flag raw socket construction in rabit_tpu/ outside the allowlist
-    (``# noqa: R001`` on the line exempts it)."""
-    if not rel.startswith("rabit_tpu" + os.sep) or rel in R001_ALLOWED:
-        return []
-    exempt = set()
-    for i, line in enumerate(src.splitlines(), 1):
-        if "# noqa" in line:
-            tail = line.split("# noqa", 1)[1].strip()
-            if not tail.startswith(":") or "R001" in tail:
-                exempt.add(i)
-    issues = []
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if not (isinstance(f, ast.Attribute) and f.attr in _R001_CALLS
-                and isinstance(f.value, ast.Name)
-                and f.value.id == "socket"):
-            continue
-        if node.lineno in exempt:
-            continue
-        issues.append((
-            rel, node.lineno, "R001",
-            f"raw socket.{f.attr}() in control-plane code — use "
-            "rabit_tpu.utils.retry.connect_with_retry (or add the file "
-            "to R001_ALLOWED if it is a server/injector)"))
-    return issues
+    """Legacy signature: R001 findings with per-line noqa applied."""
+    ctx = FileContext(os.path.join(REPO, rel), src)
+    return [i for i in check_raw_sockets(ctx)
+            if not ctx.suppressed(i[1], "R001")]
 
 
-def _r002_issues(rel, tree):
-    """World-size-derived state modules must expose the epoch-reset
-    hook (an ``epoch_reset`` def anywhere in the module — top level or
-    a method)."""
-    if rel not in R002_MODULES:
-        return []
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                and node.name == _R002_HOOK:
-            return []
-    return [(rel, 1, "R002",
-             f"module holds world-size-derived state but defines no "
-             f"'{_R002_HOOK}(world)' hook (see R002_MODULES; elastic "
-             "resizes call it on every registration-epoch transition)")]
+def _r004_issues(rel, tree):
+    """Legacy signature: R004 findings for one parsed file."""
+    return check_recovery_counters(_Ctx(rel, tree))
 
 
-def _r003_mutations(fn_node):
-    """(lineno, description) for every journaled-state mutation inside
-    ``fn_node``: a store/augassign to a R003_STATE attribute, a
-    subscript store through one (``self._ranks[t] = r``), or a
-    membership-transition method call (any receiver — locals like
-    ``m = self._member`` must not hide one)."""
-    out = []
-
-    def _attr_store(target):
-        if isinstance(target, ast.Attribute) and target.attr in R003_STATE:
-            return target.attr
-        if isinstance(target, ast.Subscript) and \
-                isinstance(target.value, ast.Attribute) and \
-                target.value.attr in R003_STATE:
-            return target.value.attr
-        return None
-
-    for node in ast.walk(fn_node):
-        if isinstance(node, ast.Assign):
-            for t in node.targets:
-                name = _attr_store(t)
-                if name:
-                    out.append((node.lineno, f"store to {name}"))
-        elif isinstance(node, ast.AugAssign):
-            name = _attr_store(node.target)
-            if name:
-                out.append((node.lineno, f"store to {name}"))
-        elif isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                node.func.attr in _R003_MEMBER_MUTATORS:
-            out.append((node.lineno, f"membership .{node.func.attr}()"))
-    return out
-
-
-def _r003_issues(rel, tree):
-    if rel != R003_FILE:
-        return []
-    issues = []
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if node.name == "__init__" or \
-                node.name.startswith(_R003_EXEMPT_PREFIXES):
-            continue
-        muts = _r003_mutations(node)
-        if muts and not _calls_any(node, {"_wal"}):
-            line, what = muts[0]
-            issues.append((
-                rel, line, "R003",
-                f"'{node.name}' mutates journaled tracker state "
-                f"({what}) without a self._wal(...) call — a resumed "
-                "tracker would forget it (see tracker/wal.py)"))
-    return issues
-
-
-def _calls_any(fn_node, call_names) -> bool:
-    for node in ast.walk(fn_node):
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        name = f.attr if isinstance(f, ast.Attribute) else (
-            f.id if isinstance(f, ast.Name) else None)
-        if name in call_names:
-            return True
-    return False
-
-
-def _has_span_call(fn_node) -> bool:
-    return _calls_any(fn_node, _SPAN_CALL_NAMES)
-
-
-def _has_counter_call(fn_node) -> bool:
-    return _calls_any(fn_node, _COUNTER_CALL_NAMES)
-
-
-def iter_py_files(paths):
-    for p in paths:
-        full = p if os.path.isabs(p) else os.path.join(REPO, p)
-        if os.path.isfile(full) and full.endswith(".py"):
-            yield full
-        elif os.path.isdir(full):
-            for dirpath, dirnames, filenames in os.walk(full):
-                dirnames[:] = [d for d in sorted(dirnames)
-                               if d not in SKIP_DIRS]
-                for f in sorted(filenames):
-                    if f.endswith(".py"):
-                        yield os.path.join(dirpath, f)
-
-
-def _noqa_lines(src: str):
-    """line numbers (1-based) carrying a blanket or F401 noqa. The
-    marker can sit on any line of a multi-line import; map it to the
-    statement via the AST node's line span instead of exact match."""
-    out = set()
-    for i, line in enumerate(src.splitlines(), 1):
-        if "# noqa" in line:
-            tail = line.split("# noqa", 1)[1].strip()
-            if not tail.startswith(":") or "F401" in tail:
-                out.add(i)
-    return out
-
-
-class _Usage(ast.NodeVisitor):
-    """Names referenced anywhere in the module (Load/Del contexts plus
-    __all__ strings); the root of an attribute chain counts for
-    ``import a.b`` style bindings."""
-
-    def __init__(self):
-        self.used = set()
-
-    def visit_Name(self, node):
-        if not isinstance(node.ctx, ast.Store):
-            self.used.add(node.id)
-        self.generic_visit(node)
-
-    def visit_Assign(self, node):
-        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
-        if "__all__" in targets and isinstance(node.value,
-                                               (ast.List, ast.Tuple)):
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and \
-                        isinstance(elt.value, str):
-                    self.used.add(elt.value)
-        self.generic_visit(node)
-
-
-def check_file(path: str):
-    issues = []
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    rel = os.path.relpath(path, REPO)
-    for i, line in enumerate(src.splitlines(), 1):
-        body = line.rstrip("\n")
-        if body != body.rstrip():
-            issues.append((rel, i, "W291", "trailing whitespace"))
-        stripped = body.lstrip(" ")
-        if stripped.startswith("\t"):
-            issues.append((rel, i, "W191", "tab in indentation"))
-    try:
-        tree = ast.parse(src, filename=rel)
-    except SyntaxError as e:
-        issues.append((rel, e.lineno or 0, "E999",
-                       f"syntax error: {e.msg}"))
-        return issues
-    noqa = _noqa_lines(src)
-    usage = _Usage()
-    usage.visit(tree)
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.Import, ast.ImportFrom)):
-            continue
-        span = set(range(node.lineno, (node.end_lineno or node.lineno) + 1))
-        if span & noqa:
-            continue
-        if isinstance(node, ast.ImportFrom) and node.module == "__future__":
-            continue
-        for alias in node.names:
-            if alias.name == "*":
-                continue
-            bound = alias.asname or alias.name.split(".")[0]
-            if bound not in usage.used:
-                shown = alias.name + (f" as {alias.asname}"
-                                      if alias.asname else "")
-                issues.append((rel, node.lineno, "F401",
-                               f"'{shown}' imported but unused"))
-    issues.extend(_r001_issues(rel, tree, src))
-    issues.extend(_r002_issues(rel, tree))
-    issues.extend(_r003_issues(rel, tree))
-    issues.extend(_r004_issues(rel, tree))
-    issues.extend(_t003_issues(rel, tree))
-    required = SPAN_REQUIRED.get(rel)
-    if required:
-        seen = set()
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and node.name in required and node.name not in seen:
-                seen.add(node.name)
-                if not _has_span_call(node):
-                    issues.append((
-                        rel, node.lineno, "T001",
-                        f"collective entry point '{node.name}' has no "
-                        "telemetry span/trace_annotation"))
-        for name in sorted(required - seen):
-            issues.append((rel, 1, "T001",
-                           f"expected collective entry point '{name}' "
-                           "not found (update SPAN_REQUIRED)"))
-    counters = COUNTER_REQUIRED.get(rel)
-    if counters:
-        seen = set()
-        for node in ast.walk(tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and node.name in counters and node.name not in seen:
-                seen.add(node.name)
-                if not _has_counter_call(node):
-                    issues.append((
-                        rel, node.lineno, "T002",
-                        f"escalation path '{node.name}' records no "
-                        "telemetry counter"))
-        for name in sorted(counters - seen):
-            issues.append((rel, 1, "T002",
-                           f"expected escalation path '{name}' not "
-                           "found (update COUNTER_REQUIRED)"))
-    return issues
-
-
-def main() -> int:
-    paths = sys.argv[1:] or list(DEFAULT_ROOTS)
-    issues = []
-    n_files = 0
-    for path in iter_py_files(paths):
-        n_files += 1
-        issues.extend(check_file(path))
-    for rel, line, code, msg in issues:
-        print(f"{rel}:{line}: {code} {msg}")
-    if issues:
-        print(f"{len(issues)} issue(s) in {n_files} file(s)")
-        return 1
-    print(f"lint clean ({n_files} files)")
-    return 0
+def _t003_issues(rel, tree):
+    """Legacy signature: T003 findings for one parsed file."""
+    return check_metric_families(_Ctx(rel, tree))
 
 
 if __name__ == "__main__":
