@@ -162,8 +162,78 @@ def smoke() -> int:
         assert len(flips) == 1, f"expected 1 bitflip event: {proxy.events}"
         assert proxy.accepted >= 2, "corruption was never detected/retried"
     srv.close()
+
+    # round 5: job_storm (ISSUE 15) — a seeded burst of rogue submits
+    # and half-open starts hammers a REAL multi-job tracker from the
+    # proxy's storm thread. Admission answers every well-formed rogue
+    # immediately (queued/shed/error — never ok, never a stall), the
+    # half-open preambles die at the wire layer, and a legitimate
+    # job's whole world registers to completion DURING the storm.
+    import os
+
+    from ..tracker import jobs as tjobs
+    from ..tracker.tracker import Tracker
+
+    env_save = {k: os.environ.get(k) for k in
+                ("RABIT_MULTI_JOB", "RABIT_MAX_JOBS",
+                 "RABIT_ADMISSION_QUEUE")}
+    os.environ["RABIT_MULTI_JOB"] = "1"
+    os.environ["RABIT_MAX_JOBS"] = "1"
+    os.environ["RABIT_ADMISSION_QUEUE"] = "1"
+    try:
+        tr = Tracker(2).start()
+        try:
+            assert tjobs.submit(tr.host, tr.port, "live", 2)["ok"] == 1
+            storm_sched = Schedule([Rule("job_storm",
+                                         window_s=(0.0, 5.0), burst=6)],
+                                   seed=23)
+            assert storm_sched.for_target("link").rules == [], \
+                "job_storm leaked onto link proxies"
+            with ChaosProxy(tr.host, tr.port, storm_sched,
+                            name="chaos-smoke-storm") as sproxy:
+                # the live job keeps working THROUGH the storm: both
+                # workers register and the world forms at epoch 1
+                conns = [tjobs.wire_register(tr.host, tr.port, f"live/{i}")
+                         for i in range(2)]
+                got = sorted(tjobs.wire_read_assignment(c) for c in conns)
+                assert got == [(0, 2, 1), (1, 2, 1)], got
+                import time as _time
+                deadline = _time.monotonic() + 10.0
+                while not sproxy.storm_results \
+                        and _time.monotonic() < deadline:
+                    _time.sleep(0.02)
+                assert sproxy.storm_results, "storm thread never fired"
+                tally = sproxy.storm_results[0]
+                storms = [e for e in sproxy.events if e[1] == "job_storm"]
+                assert len(storms) == 1, \
+                    f"expected 1 job_storm event: {sproxy.events}"
+                assert tally["submits"] >= 1 and tally["half_open"] >= 1, \
+                    tally
+                assert all(not v.get("ok") for v in tally["verdicts"]), \
+                    f"a rogue submit was admitted: {tally['verdicts']}"
+                assert any(v.get("queued") or v.get("shed")
+                           for v in tally["verdicts"]), \
+                    f"admission never queued/shed: {tally['verdicts']}"
+            # the tracker survived the storm with the live job intact:
+            # its resubmit is an idempotent ok and nothing leaked into
+            # its quarantine
+            v = tjobs.submit(tr.host, tr.port, "live", 2)
+            assert v.get("already") == 1, v
+            live = tr.job("live")
+            assert live.status == "live" and live.quarantined == 0
+            for i in range(2):
+                tjobs.wire_shutdown(tr.host, tr.port, f"live/{i}")
+        finally:
+            tr.stop()
+    finally:
+        for k, v in env_save.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     print("chaos smoke ok (1 reset + 1 tracker_kill + 1 tracker_partition "
-          "+ 1 bitflip injected, retry recovered, payload intact)")
+          "+ 1 bitflip + 1 job_storm injected, retry recovered, payload "
+          "intact, admission shed the storm)")
     return 0
 
 

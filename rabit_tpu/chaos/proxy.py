@@ -23,6 +23,14 @@ is applied to the byte stream itself:
                   the silent-corruption shape (flaky NIC, bad cable)
                   that only end-to-end payload CRC catches; the bytes
                   still flow, just wrong
+- ``job_storm``   on entering ``window_s``, hurl a seeded ``burst`` of
+                  rogue control connections at the upstream tracker —
+                  bogus ``submit`` payloads interleaved with half-open
+                  ``start`` preambles — the thundering-herd shape that
+                  multi-job admission control must shed without
+                  stalling live jobs (generative: the storm IS the
+                  traffic, fired from its own clock thread rather than
+                  a pump)
 
 Faults fire on the proxy's own threads; the proxied processes observe
 only their sockets misbehaving, exactly as with real network faults.
@@ -31,6 +39,7 @@ No-fault configs forward byte-exactly (pinned by tier-1 tests).
 
 from __future__ import annotations
 
+import json
 import random
 import select
 import socket
@@ -79,6 +88,78 @@ def _soft_close(sock: Optional[socket.socket]) -> None:
         sock.close()
     except OSError:
         pass
+
+
+# tracker/tracker.py MAGIC — the storm speaks just enough of the
+# control protocol (magic u32 + length-prefixed strings) to be rude
+_WIRE_MAGIC = 0x52425401
+
+
+def run_job_storm(host: str, port: int, rule: Rule, seed: int) -> dict:
+    """Fire one ``job_storm``: open ``rule.burst`` rogue connections
+    against the tracker at ``host:port``. Even draws send a complete
+    ``submit`` for a job that should never be admitted (fresh bogus
+    name; a third of them carry garbage payloads) and collect the
+    verdict; odd draws send a half-open ``start`` preamble — a length
+    prefix promising more bytes than ever arrive — then vanish with an
+    RST (the crashed-launcher shape). Seeded: two storms with the same
+    ``(seed, rule)`` emit byte-identical traffic in the same order.
+    Returns a tally the chaos smoke and cluster tests assert on."""
+    rng = random.Random(seed * 1_000_003 + 17)
+    tally = {"opened": 0, "submits": 0, "half_open": 0, "errors": 0,
+             "verdicts": []}
+
+    def _s(conn: socket.socket, text: str) -> None:
+        b = text.encode()
+        conn.sendall(struct.pack("<I", len(b)) + b)
+
+    def _recv_exact(conn: socket.socket, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = conn.recv(n - len(out))
+            if not chunk:
+                raise OSError("tracker closed mid-verdict")
+            out += chunk
+        return out
+
+    for i in range(rule.burst):
+        job = f"storm-{seed % 997}-{i}"
+        try:
+            conn = socket.create_connection(  # noqa: R001 - rogue client
+                (host, port), timeout=5.0)
+        except OSError:
+            tally["errors"] += 1
+            continue
+        tally["opened"] += 1
+        try:
+            conn.settimeout(5.0)
+            conn.sendall(struct.pack("<I", _WIRE_MAGIC))
+            if i % 2 == 0:
+                _s(conn, "submit")
+                _s(conn, job)
+                conn.sendall(struct.pack("<I", 0))  # num_attempt
+                if rng.random() < 0.34:
+                    _s(conn, "{not json")  # malformed: error verdict
+                else:
+                    _s(conn, json.dumps({
+                        "job": job, "elastic": False,
+                        "nworkers": rng.randrange(2, 64)}))
+                tally["submits"] += 1
+                n = struct.unpack("<I", _recv_exact(conn, 4))[0]
+                tally["verdicts"].append(
+                    json.loads(_recv_exact(conn, n).decode()))
+            else:
+                _s(conn, "start")
+                partial = f"{job}/0".encode()
+                conn.sendall(struct.pack("<I", len(partial) + 64)
+                             + partial)  # promise bytes that never come
+                tally["half_open"] += 1
+        except (OSError, ValueError):
+            tally["errors"] += 1
+        finally:
+            _hard_close(conn)
+        time.sleep(rng.random() * 0.01)  # jittered pacing, still seeded
+    return tally
 
 
 class _Conn:
@@ -138,6 +219,9 @@ class ChaosProxy:
         # observability: (t_rel, kind, conn_index) per injected fault,
         # plus totals the byte-accuracy tests assert on
         self.events: List[Tuple[float, str, int]] = []
+        # per-firing job_storm tallies (appended under _lock; tests
+        # poll this to know the burst finished)
+        self.storm_results: List[dict] = []
         self.accepted = 0
         self.refused = 0
         self.bytes_forwarded = 0
@@ -150,6 +234,14 @@ class ChaosProxy:
         self._thread = threading.Thread(target=self._serve, daemon=True,
                                         name=f"{self.name}-accept")
         self._thread.start()
+        # generative rules: job_storm has no byte stream to mutate (the
+        # storm IS the traffic), so each one gets a clock-driven thread
+        # aimed at whatever upstream retarget() currently points at
+        for idx, rule in enumerate(self.schedule.rules):
+            if rule.kind == "job_storm":
+                threading.Thread(target=self._storm_loop,
+                                 args=(rule, idx), daemon=True,
+                                 name=f"{self.name}-storm-{idx}").start()
         return self
 
     def stop(self) -> None:
@@ -199,6 +291,25 @@ class ChaosProxy:
         print(f"[{self.name}] t={self.elapsed():.2f}s inject {kind} "
               f"conn#{conn_index} -> {self.upstream[0]}:{self.upstream[1]}",
               file=sys.stderr, flush=True)
+
+    def _storm_loop(self, rule: Rule, rule_idx: int) -> None:
+        """Clock half of ``job_storm``: sleep to the window edge, spend
+        one firing, hurl the burst at the current upstream, and record
+        the tally in :attr:`storm_results`."""
+        start = rule.window_s[0] if rule.window_s else 0.0
+        while self.elapsed() < start and not self._done.is_set():
+            time.sleep(min(0.02, max(0.001, start - self.elapsed())))
+        if self._done.is_set() or not self._in_window(rule):
+            return
+        if not Schedule.consume(rule):
+            return
+        self._event("job_storm", -1)
+        with self._lock:
+            host, port = self.upstream
+        tally = run_job_storm(host, port, rule,
+                              self.schedule.seed * 1_000_003 + rule_idx)
+        with self._lock:
+            self.storm_results.append(tally)
 
     # -- accept loop ------------------------------------------------------
     def _in_window(self, rule: Rule) -> bool:

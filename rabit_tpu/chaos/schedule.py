@@ -12,7 +12,7 @@ Rule fields (all optional except ``kind``):
 ========== ===========================================================
 ``kind``   ``delay`` | ``reset`` | ``partial`` | ``partition`` |
            ``blackout`` | ``tracker_kill`` | ``tracker_partition`` |
-           ``bitflip``
+           ``bitflip`` | ``job_storm``
 ``conn``   apply only to the nth accepted connection (0-based);
            ``None`` = every connection
 ``prob``   apply with this probability (seeded draw); default 1.0
@@ -43,7 +43,17 @@ Rule fields (all optional except ``kind``):
            frame-CRC data plane must reject and retransmit; requires
            ``window_s``, ``after_bytes`` or ``conn`` as an anchor,
            defaults ``max_times`` to 1, usually ``target="link"`` —
-           the control-plane protocol has no CRC layer)
+           the control-plane protocol has no CRC layer);
+           ``job_storm`` opens a seeded ``burst`` of rogue control
+           connections — bogus ``submit`` payloads interleaved with
+           half-open ``start`` preambles — straight at the proxied
+           tracker on entering the window (the thundering-herd /
+           misbehaving-launcher shape admission control must shed
+           without stalling live jobs; requires ``window_s``,
+           implicitly ``target="tracker"``, defaults ``max_times``
+           to 1)
+``burst``  ``job_storm``: how many rogue connections one firing
+           opens (default 8)
 ``target``  ``"tracker"`` | ``"link"`` | ``None`` (both, the
            default): which proxy class runs the rule. Link wiring has
            no retry around an accepted-then-reset handshake (a peer
@@ -63,20 +73,21 @@ import random
 from typing import List, Optional, Sequence, Tuple
 
 KINDS = ("delay", "reset", "partial", "partition", "blackout",
-         "tracker_kill", "tracker_partition", "bitflip")
+         "tracker_kill", "tracker_partition", "bitflip", "job_storm")
 TARGETS = ("tracker", "link")
 
 
 class Rule:
     __slots__ = ("kind", "conn", "prob", "max_times", "after_bytes",
-                 "delay_ms", "truncate_to", "window_s", "target", "fired")
+                 "delay_ms", "truncate_to", "window_s", "target",
+                 "burst", "fired")
 
     def __init__(self, kind: str, conn: Optional[int] = None,
                  prob: float = 1.0, max_times: Optional[int] = None,
                  after_bytes: int = 0, delay_ms: float = 0.0,
                  truncate_to: int = 0,
                  window_s: Optional[Sequence[float]] = None,
-                 target: Optional[str] = None):
+                 target: Optional[str] = None, burst: int = 8):
         if kind not in KINDS:
             raise ValueError(f"chaos rule kind must be one of {KINDS}, "
                              f"got {kind!r}")
@@ -110,6 +121,19 @@ class Rule:
                                  "after_bytes or conn")
             if max_times is None:
                 max_times = 1
+        if kind == "job_storm":
+            # the storm is generative (it OPENS connections instead of
+            # mutating a stream), so it needs a window to anchor the
+            # burst, is tracker-class by construction — link listeners
+            # have no submit verb to abuse — and fires once by default
+            # (a sustained storm is a different experiment than a
+            # thundering herd)
+            if window_s is None:
+                raise ValueError("chaos 'job_storm' rule requires window_s")
+            if target is None:
+                target = "tracker"
+            if max_times is None:
+                max_times = 1
         if target is not None and target not in TARGETS:
             raise ValueError(f"chaos rule target must be one of {TARGETS} "
                              f"or None, got {target!r}")
@@ -124,6 +148,7 @@ class Rule:
         self.window_s: Optional[Tuple[float, float]] = (
             None if window_s is None
             else (float(window_s[0]), float(window_s[1])))
+        self.burst = max(1, int(burst))
         self.fired = 0  # lifetime firing counter (proxy bumps it)
 
     def to_dict(self) -> dict:
@@ -144,12 +169,14 @@ class Rule:
             d["window_s"] = list(self.window_s)
         if self.target is not None:
             d["target"] = self.target
+        if self.burst != 8:
+            d["burst"] = self.burst
         return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "Rule":
         known = {"kind", "conn", "prob", "max_times", "after_bytes",
-                 "delay_ms", "truncate_to", "window_s", "target"}
+                 "delay_ms", "truncate_to", "window_s", "target", "burst"}
         extra = set(d) - known
         if extra:
             raise ValueError(f"unknown chaos rule field(s) {sorted(extra)}")

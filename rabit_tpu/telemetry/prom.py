@@ -91,6 +91,12 @@ METRIC_FAMILIES = (
     # self-healing data plane (engine/native.py, ISSUE 13)
     "rabit_dataplane_retries_total",
     "rabit_frame_crc_rejects_total",
+    # multi-job control plane (tracker/tracker.py, ISSUE 15)
+    "rabit_tracker_jobs",
+    "rabit_admission_queue_depth",
+    "rabit_admission_queued_total",
+    "rabit_admission_shed_total",
+    "rabit_job_quarantined_total",
 )
 
 

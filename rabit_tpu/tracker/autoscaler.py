@@ -1,0 +1,307 @@
+"""First autoscaler loop for the multi-job control plane (ISSUE 15):
+read the tracker's fleet metrics plane, drive the existing membership
+path.
+
+The tracker already exposes everything a scheduler needs — per-job
+straggler verdicts on ``/straggler``, per-job health on ``/jobs`` —
+and already owns the only safe resize primitive: the ``evict`` wire
+command plus elastic re-formation (ISSUE 9). This module closes the
+loop: a rank that stays ``rabit_autoscale_lag`` collectives behind the
+leader for ``rabit_autoscale_strikes`` consecutive sweeps is evicted,
+so its world re-forms smaller and FASTER instead of pacing every
+round at the laggard's speed; the launcher's respawn/replacement
+machinery (``join``) grows the world back when healthy hardware
+shows up. Elastic membership becomes a scheduling primitive, not just
+a fault response.
+
+Deliberately conservative:
+
+- hysteresis (strikes) — one GC pause never costs a rank its
+  membership;
+- a world-size floor (``rabit_autoscale_min_world``) — shrinking a
+  2-rank world to 1 usually costs more than the straggler does;
+- one action per job per sweep — the world must re-form and the
+  verdict refresh before the next eviction can be justified;
+- every decision rides the public wire/HTTP planes, so the loop can
+  run anywhere the operator can reach the tracker (it holds no
+  tracker-internal state and is safe to kill at any time).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import sys
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import jobs as _jobs_mod
+
+INTERVAL_ENV = "RABIT_AUTOSCALE_INTERVAL_MS"
+LAG_ENV = "RABIT_AUTOSCALE_LAG"
+STRIKES_ENV = "RABIT_AUTOSCALE_STRIKES"
+MIN_WORLD_ENV = "RABIT_AUTOSCALE_MIN_WORLD"
+
+INTERVAL_MS_DEFAULT = 5000
+LAG_DEFAULT = 50
+STRIKES_DEFAULT = 3
+MIN_WORLD_DEFAULT = 2
+
+
+def _int_env(name: str, default: int, floor: int) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def autoscale_interval_ms() -> int:
+    """``rabit_autoscale_interval_ms`` (doc/parameters.md): sweep
+    period (floor 100 ms)."""
+    return _int_env(INTERVAL_ENV, INTERVAL_MS_DEFAULT, 100)
+
+
+def autoscale_lag() -> int:
+    """``rabit_autoscale_lag``: collectives behind the leader before a
+    rank starts accruing strikes."""
+    return _int_env(LAG_ENV, LAG_DEFAULT, 1)
+
+
+def autoscale_strikes() -> int:
+    """``rabit_autoscale_strikes``: consecutive over-threshold sweeps
+    before the autoscaler acts (hysteresis)."""
+    return _int_env(STRIKES_ENV, STRIKES_DEFAULT, 1)
+
+
+def autoscale_min_world() -> int:
+    """``rabit_autoscale_min_world``: live-world floor below which the
+    autoscaler refuses to evict."""
+    return _int_env(MIN_WORLD_ENV, MIN_WORLD_DEFAULT, 1)
+
+
+def request_evict(host: str, port: int, rank: int, reason: str,
+                  job_id: str = _jobs_mod.DEFAULT_JOB,
+                  timeout: float = 5.0) -> bool:
+    """Send the ``evict`` wire command (job-addressed when the target
+    is not the default job). Returns the tracker's ack."""
+    from ..utils import retry
+    from .tracker import MAGIC, _recv_u32, _send_str, _send_u32
+    task = _jobs_mod.job_task(job_id, "autoscaler")
+    with retry.connect_with_retry(host, int(port),
+                                  timeout=timeout) as conn:
+        conn.sendall(struct.pack("<I", MAGIC))
+        _send_str(conn, "evict")
+        _send_str(conn, task)
+        _send_u32(conn, 0)
+        _send_str(conn, json.dumps({"rank": int(rank),
+                                    "reason": reason}))
+        return _recv_u32(conn) == 1
+
+
+class Autoscaler:
+    """Poll the tracker's metrics plane; evict persistent stragglers.
+
+    ``scrape_fn(path) -> Optional[dict]`` and ``evict_fn(job, rank,
+    reason) -> bool`` are injectable so the policy is unit-testable
+    without a cluster; the defaults ride the live HTTP plane and the
+    ``evict`` wire command."""
+
+    def __init__(self, tracker_host: str, tracker_port: int,
+                 metrics_host: str, metrics_port: int,
+                 scrape_fn: Optional[Callable] = None,
+                 evict_fn: Optional[Callable] = None):
+        from ..telemetry import live
+        self.tracker_addr = (tracker_host, int(tracker_port))
+        self.metrics_addr = (metrics_host, int(metrics_port))
+        self._scrape = scrape_fn or (
+            lambda path: live.scrape_json(self.metrics_addr[0],
+                                          self.metrics_addr[1],
+                                          path=path))
+        self._evict = evict_fn or (
+            lambda job, rank, reason: request_evict(
+                self.tracker_addr[0], self.tracker_addr[1], rank,
+                reason, job_id=job))
+        self.lag = autoscale_lag()
+        self.strikes_needed = autoscale_strikes()
+        self.min_world = autoscale_min_world()
+        self._strikes: Dict[Tuple[str, int], int] = {}
+        self.evicted_total = 0
+        self.sweeps = 0
+        self._stop = threading.Event()
+
+    # -- policy -----------------------------------------------------------
+    def _job_worlds(self) -> Dict[str, dict]:
+        """job id -> /jobs doc (empty when the route is unreachable —
+        the sweep then acts only where a straggler verdict names a
+        job it can size)."""
+        doc = self._scrape("/jobs") or {}
+        out = {}
+        for jd in doc.get("jobs", []):
+            if isinstance(jd, dict) and jd.get("job"):
+                out[str(jd["job"])] = jd
+        return out
+
+    def _verdicts(self) -> List[Tuple[str, dict]]:
+        """(job id, straggler doc) pairs for this sweep: the per-job
+        map when the tracker is multi-job, else the aggregate doc
+        attributed to the default job."""
+        doc = self._scrape("/straggler")
+        if not isinstance(doc, dict):
+            return []
+        per_job = doc.get("jobs")
+        if isinstance(per_job, dict) and per_job:
+            return [(str(j), d) for j, d in sorted(per_job.items())
+                    if isinstance(d, dict)]
+        return [(_jobs_mod.DEFAULT_JOB, doc)]
+
+    def sweep(self) -> List[Tuple[str, int]]:
+        """One pass: accrue/clear strikes, evict at the threshold.
+        Returns the (job, rank) evictions performed this sweep."""
+        self.sweeps += 1  # noqa: C003 - sole writer: the run() loop
+        worlds = self._job_worlds()
+        actions: List[Tuple[str, int]] = []
+        live_keys = set()
+        for job_id, strag in self._verdicts():
+            rank = strag.get("lagging_rank")
+            lagging = (bool(strag.get("signal")) and rank is not None
+                       and int(strag.get("lag_collectives", 0))
+                       >= self.lag)
+            if not lagging:
+                continue
+            key = (job_id, int(rank))
+            live_keys.add(key)
+            n = self._strikes.get(key, 0) + 1
+            self._strikes[key] = n
+            if n < self.strikes_needed:
+                continue
+            jd = worlds.get(job_id, {})
+            world = int(jd.get("world", 0) or 0)
+            if jd and not jd.get("elastic"):
+                continue   # inelastic job: eviction would be refused
+            if world and world <= self.min_world:
+                continue   # at the floor: live with the straggler
+            reason = (f"autoscaler: {strag.get('lag_collectives')} "
+                      f"collectives behind for {n} sweeps")
+            if self._evict(job_id, int(rank), reason):
+                self.evicted_total += 1  # noqa: C003 - sole writer
+                actions.append((job_id, int(rank)))
+                self._strikes.pop(key, None)
+                print(f"[autoscaler] evicted job {job_id} rank {rank} "
+                      f"({reason})", file=sys.stderr, flush=True)
+        # a rank that recovered (or a world that re-formed) resets its
+        # strike count: hysteresis measures CONSECUTIVE bad sweeps
+        for key in list(self._strikes):
+            if key not in live_keys:
+                del self._strikes[key]
+        return actions
+
+    # -- loop -------------------------------------------------------------
+    def run(self) -> None:
+        period = autoscale_interval_ms() / 1e3
+        while not self._stop.wait(period):
+            try:
+                self.sweep()
+            except Exception as e:  # noqa: BLE001 - loop must survive
+                print(f"[autoscaler] sweep failed: {e}",
+                      file=sys.stderr, flush=True)
+
+    def start(self) -> "Autoscaler":
+        self._thread = threading.Thread(target=self.run,
+                                        name="rabit-autoscaler",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def _smoke() -> None:
+    """Policy unit-drive: hysteresis, the world floor, per-job strike
+    isolation, and strike reset on recovery — no cluster needed."""
+    os.environ[STRIKES_ENV] = "2"
+    os.environ[LAG_ENV] = "10"
+    os.environ[MIN_WORLD_ENV] = "2"
+    try:
+        state = {"strag": None, "jobs": None}
+        evicted = []
+
+        def scrape(path):
+            return state["strag"] if path == "/straggler" \
+                else state["jobs"]
+
+        sc = Autoscaler("127.0.0.1", 1, "127.0.0.1", 1,
+                        scrape_fn=scrape,
+                        evict_fn=lambda j, r, why: evicted.append(
+                            (j, r)) or True)
+        lag = {"signal": True, "lagging_rank": 2, "lag_collectives": 40,
+               "busy_skew_s": 1.0}
+        state["strag"] = {"signal": False, "jobs": {"jobA": dict(lag)}}
+        state["jobs"] = {"jobs": [
+            {"job": "jobA", "world": 4, "elastic": True},
+            {"job": "jobB", "world": 4, "elastic": True}]}
+        assert sc.sweep() == []          # strike 1 of 2: hysteresis
+        assert sc.sweep() == [("jobA", 2)] and evicted == [("jobA", 2)]
+        # recovery clears strikes: one bad sweep after a clean one
+        # must not evict
+        state["strag"] = {"signal": False, "jobs": {}}
+        sc.sweep()
+        state["strag"] = {"signal": False, "jobs": {"jobA": dict(lag)}}
+        assert sc.sweep() == []
+        # world floor: a 2-rank world keeps its straggler
+        state["jobs"] = {"jobs": [
+            {"job": "jobA", "world": 2, "elastic": True}]}
+        assert sc.sweep() == [] and sc.sweep() == []
+        # below the lag threshold: never even a strike
+        small = dict(lag)
+        small["lag_collectives"] = 3
+        state["strag"] = {"signal": False, "jobs": {"jobB": small}}
+        state["jobs"] = {"jobs": [
+            {"job": "jobB", "world": 4, "elastic": True}]}
+        assert sc.sweep() == [] and sc.sweep() == [] and sc.sweep() == []
+        assert ("jobB", 2) not in sc._strikes
+        # inelastic jobs are never shrunk
+        state["strag"] = {"signal": False, "jobs": {"jobB": dict(lag)}}
+        state["jobs"] = {"jobs": [
+            {"job": "jobB", "world": 4, "elastic": False}]}
+        assert sc.sweep() == [] and sc.sweep() == []
+        assert sc.evicted_total == 1
+        print("autoscaler smoke ok")
+    finally:
+        for k in (STRIKES_ENV, LAG_ENV, MIN_WORLD_ENV):
+            os.environ.pop(k, None)
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    """Run the autoscaler against a live tracker: ``--tracker
+    HOST:PORT`` (wire commands) + ``--metrics HOST:PORT`` (the
+    tracker's fleet /straggler + /jobs plane)."""
+    import argparse
+    ap = argparse.ArgumentParser(description=_main.__doc__)
+    ap.add_argument("--tracker", required=False,
+                    help="tracker wire address HOST:PORT")
+    ap.add_argument("--metrics", required=False,
+                    help="tracker fleet-metrics address HOST:PORT")
+    ap.add_argument("--smoke", action="store_true")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        _smoke()
+        return 0
+    if not args.tracker or not args.metrics:
+        ap.error("--tracker and --metrics are required (or --smoke)")
+    th, tp = args.tracker.rsplit(":", 1)
+    mh, mp = args.metrics.rsplit(":", 1)
+    sc = Autoscaler(th, int(tp), mh, int(mp))
+    print(f"[autoscaler] watching {args.metrics}, driving "
+          f"{args.tracker} (lag>={sc.lag}, strikes={sc.strikes_needed},"
+          f" min_world={sc.min_world})", file=sys.stderr, flush=True)
+    try:
+        sc.run()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

@@ -7,6 +7,14 @@ with the attempt counter exported so mock kill schedules advance).
 Usage:
     python -m rabit_tpu.tracker.launch -n 4 [--max-attempts 20] \
         prog arg1 key=value ...
+
+``--submit HOST:PORT`` targets a RUNNING multi-job tracker instead of
+starting one: the launcher submits ``--job`` through the admission
+plane (backing off on queued/shed verdicts per the tracker's
+``retry_after_ms`` hints — overload sheds, it never stalls), then
+spawns its workers with job-scoped task ids (``<job>/<i>``) against
+the shared control plane. Many such launchers share one tracker, each
+inside its own fault domain (doc/fault_tolerance.md).
 """
 
 from __future__ import annotations
@@ -423,6 +431,86 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
             tracker.stop()
 
 
+def submit_launch(addr: str, job_id: str, nworkers: int, cmd: List[str],
+                  max_attempts: int = 20, timeout: float = 300.0,
+                  elastic: bool = False, max_wait_s: float = 60.0,
+                  quiet: bool = False) -> int:
+    """``launch --submit``: run ``cmd`` as one JOB on an already-running
+    multi-job tracker at ``addr`` (``HOST:PORT``). Admission first —
+    :func:`jobs.submit_blocking` honors queued/shed backoff hints until
+    admitted or ``max_wait_s`` lapses — then the same spawn/respawn
+    discipline as :func:`launch`, with every worker addressing its own
+    fault domain via the ``<job>/<i>`` task id. The tracker is NOT
+    owned here: its lifecycle (and any chaos/standby fronting) belongs
+    to whoever started it."""
+    from . import jobs as _jobs_mod
+    host, _, port_s = addr.rpartition(":")
+    if not host or not port_s.isdigit():
+        print(f"[submit] bad --submit address {addr!r} "
+              f"(want HOST:PORT)", file=sys.stderr, flush=True)
+        return 2
+    port = int(port_s)
+    try:
+        verdict = _jobs_mod.submit_blocking(
+            host, port, job_id, nworkers, elastic=elastic,
+            max_wait_s=max_wait_s)
+    except (TimeoutError, OSError) as e:
+        print(f"[submit] job {job_id!r} not admitted: {e}",
+              file=sys.stderr, flush=True)
+        return 1
+    if not quiet:
+        print(f"[submit] job {job_id!r} admitted at {host}:{port} "
+              f"({verdict})", file=sys.stderr, flush=True)
+    procs: Dict[int, subprocess.Popen] = {}
+    attempts: Dict[int, int] = {i: 0 for i in range(nworkers)}
+    finished: Dict[int, bool] = {i: False for i in range(nworkers)}
+
+    def spawn(i: int) -> None:
+        env = dict(os.environ)
+        env["RABIT_TRACKER_URI"] = host
+        env["RABIT_TRACKER_PORT"] = str(port)
+        env["RABIT_TASK_ID"] = f"{job_id}{_jobs_mod.JOB_SEP}{i}"
+        env["RABIT_NUM_TRIAL"] = str(attempts[i])
+        env["RABIT_WORLD_SIZE"] = str(nworkers)
+        env["RABIT_MULTI_JOB"] = "1"
+        if elastic:
+            env["RABIT_ELASTIC"] = "1"
+        procs[i] = subprocess.Popen(cmd, env=env)
+        attempts[i] += 1
+
+    for i in range(nworkers):
+        spawn(i)
+    deadline = time.monotonic() + timeout
+    rc = 0
+    try:
+        while not all(finished.values()):
+            if time.monotonic() > deadline:
+                print(f"[submit] job {job_id!r} timed out after "
+                      f"{timeout:.0f}s", file=sys.stderr, flush=True)
+                rc = 1
+                break
+            time.sleep(0.1)
+            for i, p in list(procs.items()):
+                code = p.poll()
+                if code is None or finished[i]:
+                    continue
+                if code == 0:
+                    finished[i] = True
+                elif attempts[i] >= max_attempts:
+                    print(f"[submit] worker {job_id}/{i} exhausted "
+                          f"{max_attempts} attempts", file=sys.stderr,
+                          flush=True)
+                    rc = 1
+                    finished[i] = True
+                else:
+                    spawn(i)
+    finally:
+        for p in procs.values():
+            if p.poll() is None:
+                p.kill()
+    return rc
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
@@ -435,12 +523,31 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="elastic world membership: evict dead ranks "
                          "so survivors continue at N-1, re-admit them "
                          "on relaunch (default: RABIT_ELASTIC env)")
+    ap.add_argument("--submit", default=None, metavar="HOST:PORT",
+                    help="submit --job to an already-running multi-job "
+                         "tracker instead of starting one; backs off "
+                         "and retries on queued/shed verdicts")
+    ap.add_argument("--job", default=None, metavar="NAME",
+                    help="job id for --submit (default: job-<pid>); "
+                         "workers get task ids NAME/<i>")
+    ap.add_argument("--submit-wait", type=float, default=60.0,
+                    metavar="S", help="admission budget for --submit "
+                                      "before giving up (default 60)")
     ap.add_argument("cmd", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     if args.cmd and args.cmd[0] == "--":
         args.cmd = args.cmd[1:]
     if not args.cmd:
         ap.error("missing worker command")
+    if args.submit:
+        return submit_launch(args.submit,
+                             args.job or f"job-{os.getpid()}",
+                             args.num_workers, args.cmd,
+                             args.max_attempts, args.timeout,
+                             elastic=bool(args.elastic),
+                             max_wait_s=args.submit_wait)
+    if args.job:
+        ap.error("--job requires --submit")
     return launch(args.num_workers, args.cmd, args.max_attempts,
                   args.timeout, chaos=args.chaos, elastic=args.elastic)
 

@@ -290,7 +290,14 @@ class StandbyTracker:
         replay the replicated journal into a real Tracker on the
         advertised failover address. The promoted tracker claims the
         lease under its OWN node id from here on — it is the leader
-        now."""
+        now.
+
+        Multi-job leaders need nothing extra here: ``job_open`` /
+        ``job_close`` and job-tagged transitions ride the SAME
+        replicated stream as everything else, so the resume replay
+        re-adopts every live job — own ranks, own epoch, own fault
+        domain — exactly as ``--resume`` does on a cold restart
+        (pinned by tests/test_multi_job.py)."""
         self._wal.close()
         try:
             self._placeholder.close()

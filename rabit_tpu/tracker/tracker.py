@@ -8,6 +8,19 @@ fail-restart-and-catch-up recovery), computes the tree + ring topology,
 barriers each (re)registration epoch so every worker is listening before
 link wiring starts, and relays ``print``/``shutdown`` commands.
 
+Multi-job control plane (ISSUE 15): one tracker serves many
+fault-isolated jobs. Job addressing rides the EXISTING wire protocol —
+a worker whose task_id is ``<job>/<task>`` addresses job ``<job>``
+(tracker/jobs.py), and a task_id without a separator addresses the
+implicit ``default`` job. Task ids are only ever split when
+``rabit_multi_job`` is set; unset, every byte on the wire and in the
+WAL is identical to the single-job tracker. Per-world state lives on a
+per-job ``JobState`` (lint R007 keeps it there); exceptions handling
+one job's commands are quarantined at the job boundary, never raised
+into the accept loop; ``rabit_max_jobs``/``rabit_max_fleet_ranks``
+caps + a bounded FIFO admission queue make overload a degraded mode
+(submitters are shed with a retry-after hint, never stalled).
+
 Wire protocol (binary, little-endian, length-prefixed strings):
   worker -> tracker: magic u32 0x52425401, cmd str, task_id str,
                      num_attempt u32
@@ -61,6 +74,17 @@ Wire protocol (binary, little-endian, length-prefixed strings):
                    poll sweep has per-rank busy times. Workers cache it
                    verbatim as their candidate and adopt it fleet-wide
                    at agreement boundaries (rabit_skew_adapt).
+    submit:        + payload str (JSON {"job","nworkers","elastic"}:
+                   an admission request for a new job, ISSUE 15).
+                   tracker -> worker: payload str, a JSON verdict
+                   answered IMMEDIATELY: {"ok": 1} admitted (or
+                   already open — idempotent), {"ok": 0, "queued": 1,
+                   "position": p, "retry_after_ms": n} parked in the
+                   bounded FIFO admission queue, {"ok": 0, "shed": 1,
+                   "retry_after_ms": n} shed past the queue depth, or
+                   {"ok": 0, "error": ...} never admissible. The
+                   tracker never stalls a submitter — overload is a
+                   backoff hint, not a hang.
   tracker -> worker (start/recover): rank u32, world u32, epoch u32,
     coord_host str, coord_port u32 (this epoch's tracker-hosted device
     -world coordination service; empty/0 when coordinator hosting is
@@ -79,7 +103,8 @@ The epoch counts completed registration batches: every live worker
 re-registers in the same batch during recovery, so all members of a
 batch observe the same epoch — the agreement the accelerator data plane
 needs to tear down/re-form its fixed-membership device world without an
-extra consensus round.
+extra consensus round. Epochs are per-job: job B forming never bumps
+job A's epoch.
 """
 
 from __future__ import annotations
@@ -94,6 +119,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ..telemetry.aggregate import format_fleet_table, merge_summaries
+from . import jobs as _jobs_mod
 from . import membership as _membership
 from . import wal as _wal_mod
 
@@ -224,8 +250,9 @@ class Tracker:
                  wal_dir: Optional[str] = None,
                  resume: bool = False,
                  lease_ms: Optional[int] = None,
-                 node_id: str = "leader"):
-        self.nworkers = nworkers
+                 node_id: str = "leader",
+                 multi_job: Optional[bool] = None):
+        self.nworkers = nworkers            # fleet-global: default-job target
         # elastic world membership (ISSUE 9): when on, the tracker is
         # the membership authority for the live job — dead ranks are
         # EVICTED (``evict`` command, or poll evidence of a silent
@@ -237,27 +264,38 @@ class Tracker:
         # for the full fixed world exactly as before.
         if elastic is None:
             elastic = _membership.elastic_enabled()
-        self.elastic = bool(elastic)
-        self._member = (_membership.MembershipView(nworkers)
-                        if self.elastic else None)
-        self._endpoint_misses: Dict[str, int] = {}
-        self.sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self.elastic = bool(elastic)        # fleet-global: job default
+        # multi-job control plane (ISSUE 15): per-world state lives on
+        # JobState objects (tracker/jobs.py); the tracker itself keeps
+        # only fleet-global machinery (every attribute assigned on
+        # ``self`` below is annotated so — lint R007 enforces the
+        # split). The implicit ``default`` job always exists: with
+        # ``rabit_multi_job`` unset it IS the tracker's one world and
+        # task ids are never split, so wire and WAL bytes are identical
+        # to the single-job control plane.
+        if multi_job is None:
+            multi_job = _jobs_mod.multi_job_enabled()
+        self.multi_job = bool(multi_job)    # fleet-global: mode flag
+        self._default = _jobs_mod.JobState(     # fleet-global: implicit job
+            _jobs_mod.DEFAULT_JOB, nworkers, elastic=self.elastic)
+        self._jobs: Dict[str, _jobs_mod.JobState] = {  # fleet-global: job table
+            _jobs_mod.DEFAULT_JOB: self._default}
+        # admission control (ISSUE 15): caps snapshot at construction,
+        # a bounded FIFO for submissions that do not fit right now
+        self._admission = _jobs_mod.AdmissionQueue()   # fleet-global
+        self._max_jobs = _jobs_mod.max_jobs()          # fleet-global: cap
+        self._max_fleet_ranks = _jobs_mod.max_fleet_ranks()  # fleet-global
+        self.sock = socket.socket(socket.AF_INET,  # fleet-global: listener
+                                  socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
         self.sock.listen(256)
-        self.host, self.port = self.sock.getsockname()
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
-        self._ranks: Dict[str, int] = {}        # task_id -> stable rank
-        self._pending: Dict[int, Tuple[socket.socket, str, int, int,
-                               str]] = {}
-        self._epoch = 0
-        self._shutdown_ranks: set = set()
-        self._done = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self.messages: List[str] = []
-        # task_id -> latest telemetry_summary doc shipped by that worker
-        self._metrics: Dict[str, dict] = {}
+        self.host, self.port = self.sock.getsockname()  # fleet-global: addr
+        self._lock = threading.Lock()       # fleet-global: the tracker lock
+        self._cv = threading.Condition(self._lock)  # fleet-global: batch cv
+        self._done = threading.Event()      # fleet-global: lifecycle
+        self._thread: Optional[threading.Thread] = None  # fleet-global
+        self.messages: List[str] = []       # fleet-global: print relay log
         # device-world coordinator hosting (accelerator data plane): one
         # JAX coordination service per registration epoch, living HERE —
         # a service that vanishes under a live client fatally terminates
@@ -267,23 +305,19 @@ class Tracker:
         # same always-alive role, SURVEY §2 #16). Failure detection is
         # the socket control plane's job, so the services' own heartbeat
         # policing is disabled (huge timeout) — a dead worker must not
-        # poison the survivors' agents.
-        self._coordinator = coordinator
-        self._ready_timeout = (ready_timeout if ready_timeout is not None
-                               else _default_ready_timeout())
+        # poison the survivors' agents. The services themselves live on
+        # each job (an epoch is a job-scoped notion).
+        self._coordinator = coordinator     # fleet-global: config flag
+        self._ready_timeout = (             # fleet-global: config
+            ready_timeout if ready_timeout is not None
+            else _default_ready_timeout())
         # chaos hook: ``link_rewrite(peer_rank, host, port) -> (host,
         # port)`` rewrites the peer addresses advertised in _assign so
         # worker->worker links route through fault-injection proxies.
         # Rewritten peers get an EMPTY uds_token: the UDS fast path
         # would bypass a TCP proxy entirely (the token resolves on the
         # peer's host, not at the proxy).
-        self._link_rewrite = link_rewrite
-        # (epoch, service) pairs; older epochs reaped once a newer epoch
-        # fully acks (every live client has dropped its old-world client
-        # before acking — see the teardown-before-ack contract in
-        # comm.cc ReconnectLinks)
-        self._services: List[Tuple[int, object]] = []
-        self._coord_addr: Tuple[str, int] = ("", 0)
+        self._link_rewrite = link_rewrite   # fleet-global: chaos hook
         # live observability plane (off unless rabit_metrics_port /
         # RABIT_METRICS_PORT is configured): workers announce their
         # /metrics endpoints via the ``endpoint`` command; a poller
@@ -293,27 +327,11 @@ class Tracker:
         if metrics_port is None:
             raw = os.environ.get("RABIT_METRICS_PORT")
             metrics_port = int(raw) if raw not in (None, "") else None
-        self._metrics_port = metrics_port
-        self._endpoints: Dict[str, dict] = {}   # task_id -> {host,port,rank}
-        self._metrics_server = None
-        self._poll_thread: Optional[threading.Thread] = None
-        self._poll_stop = threading.Event()
-        self._poll_count = 0
-        self._last_straggler: Optional[dict] = None
-        # host topology of the last completed assignment (the ``topo``
-        # wire command's payload); {} until a batch assigns
-        self._topo: dict = {}
-        # fleet skew digest {epoch, offsets_ms, laggard} (the ``skew``
-        # wire command's payload, telemetry/skew.py); {} until the poll
-        # loop has a sweep with per-rank busy times to derive one from.
-        # The election (EWMA smoothing + laggard hysteresis) lives HERE
-        # — one FleetElection for the whole fleet — so every worker
-        # receives the same verdict and the digest's epoch bumps
-        # exactly when the election changes; workers apply it verbatim
-        # (per-process smoothing would diverge the static jit args the
-        # adapted schedules key on)
-        self._skew: dict = {}
-        self._skew_election = None  # lazy: telemetry.skew.FleetElection
+        self._metrics_port = metrics_port   # fleet-global: config
+        self._metrics_server = None         # fleet-global: one server
+        self._poll_thread: Optional[threading.Thread] = None  # fleet-global
+        self._poll_stop = threading.Event()  # fleet-global
+        self._poll_count = 0                # fleet-global: sweep counter
         # crash-recoverable control plane (ISSUE 10): when a WAL dir is
         # configured (``rabit_tracker_wal_dir``), every control-plane
         # transition below is journaled through tracker/wal.py BEFORE
@@ -321,15 +339,18 @@ class Tracker:
         # re-adopt a live world after a tracker crash — same ranks,
         # same epoch, no worker restart. With the knob unset every
         # ``_wal`` call below is a no-op and behavior is byte-identical
-        # to a WAL-less tracker.
+        # to a WAL-less tracker. The root journal is THE authority (and
+        # the replicated one); non-default jobs additionally mirror
+        # their records into ``<wal_dir>/<job_id>/`` so one job's
+        # journal can be inspected (wal.py --inspect) in isolation.
         if wal_dir is None:
             wal_dir = os.environ.get(_wal_mod.WAL_DIR_ENV) or None
-        self.wal_dir = wal_dir
-        self._wal_log: Optional[_wal_mod.WriteAheadLog] = None
-        self.restarts = 0
-        self.crashed = False
-        self._grace_until = 0.0
-        self._resumed_ranks: set = set()
+        self.wal_dir = wal_dir              # fleet-global: journal home
+        self._wal_log: Optional[_wal_mod.WriteAheadLog] = None  # fleet-global
+        self._job_wals: Dict[str, _wal_mod.WriteAheadLog] = {}  # fleet-global
+        self.restarts = 0                   # fleet-global: resume counter
+        self.crashed = False                # fleet-global: crash() flag
+        self._grace_until = 0.0             # fleet-global: resume grace
         # hot-standby leadership + WAL streaming replication (ISSUE 12):
         # only engaged when ``lease_ms`` is set (the launcher passes it
         # through ``rabit_tracker_standby``). The leader journals a
@@ -337,30 +358,30 @@ class Tracker:
         # to ``repl`` subscribers; with lease_ms unset none of this
         # exists — no lease records, no extra threads, no new gauges —
         # so a PR 10 configuration is byte-identical.
-        self.lease_ms = int(lease_ms) if lease_ms else None
-        self.node_id = str(node_id)
-        self.promoted = False       # set by a standby before start()
-        self._lease: Optional[dict] = None
-        self._lease_thread: Optional[threading.Thread] = None
+        self.lease_ms = int(lease_ms) if lease_ms else None  # fleet-global
+        self.node_id = str(node_id)         # fleet-global: identity
+        self.promoted = False               # fleet-global: standby flag
+        self._lease: Optional[dict] = None  # fleet-global: leadership
+        self._lease_thread: Optional[threading.Thread] = None  # fleet-global
         # the replication side never touches self._lock (``_wal`` runs
         # under it in several paths): frames live under their own
         # condition, appended by ``_wal`` and drained per-subscriber
-        self._repl_cv = threading.Condition()
-        self._repl_log: List[bytes] = []    # frame i carries seq i+1
-        self._repl_subs: List[dict] = []
+        self._repl_cv = threading.Condition()   # fleet-global: repl plane
+        self._repl_log: List[bytes] = []    # fleet-global: frame i = seq i+1
+        self._repl_subs: List[dict] = []    # fleet-global: subscribers
         # newest ephemeral lease heartbeat (a seq-0 frame) + a counter
         # so each subscriber can tell "a fresher one arrived"; only the
         # newest matters, so heartbeats are a slot, not a log
-        self._repl_hb: Optional[bytes] = None
-        self._repl_hb_n = 0
+        self._repl_hb: Optional[bytes] = None   # fleet-global
+        self._repl_hb_n = 0                 # fleet-global
         # the lease doc last actually journaled (vs merely heartbeat):
         # a renewal that matches it except for until_ms is idempotent
         # and stays out of the journal entirely
-        self._journaled_lease: Optional[dict] = None
+        self._journaled_lease: Optional[dict] = None  # fleet-global
         if wal_dir is not None:
-            self._wal_log = _wal_mod.WriteAheadLog(wal_dir)
+            self._wal_log = _wal_mod.WriteAheadLog(wal_dir)  # fleet-global
             records = self._wal_log.open(resume=resume)
-            self._repl_log = [
+            self._repl_log = [              # fleet-global: repl backfill
                 _wal_mod.encode_record(i + 1, kind, data)
                 for i, (kind, data) in enumerate(records)]
             if resume:
@@ -372,52 +393,159 @@ class Tracker:
                                      + resume_grace_ms() / 1e3)
                 self._note_resume(len(records))
 
+    # -- per-world delegation (ISSUE 15) ----------------------------------
+    # The single-job read surface: every per-world attribute the rest
+    # of the repo historically read off the tracker (wal/standby
+    # smokes, launch, tests) resolves to the implicit default job.
+    # Tracker code itself mutates JobState fields through an explicit
+    # ``job`` reference, never through these shims.
+    @property
+    def _ranks(self) -> Dict[str, int]:
+        return self._default._ranks
+
+    @property
+    def _pending(self) -> Dict[int, tuple]:
+        return self._default._pending
+
+    @property
+    def _epoch(self) -> int:
+        return self._default._epoch
+
+    @property
+    def _shutdown_ranks(self) -> set:
+        return self._default._shutdown_ranks
+
+    @property
+    def _metrics(self) -> Dict[str, dict]:
+        return self._default._metrics
+
+    @property
+    def _endpoints(self) -> Dict[str, dict]:
+        return self._default._endpoints
+
+    @property
+    def _topo(self) -> dict:
+        return self._default._topo
+
+    @property
+    def _skew(self) -> dict:
+        return self._default._skew
+
+    @_skew.setter
+    def _skew(self, digest: dict) -> None:
+        # test seam (test_skew forces served digests); production
+        # writers go through the poll loop's job reference + _wal
+        self._default._skew = digest
+
+    @property
+    def _member(self):
+        return self._default._member
+
+    @property
+    def _resumed_ranks(self) -> set:
+        return self._default._resumed_ranks
+
+    @property
+    def _last_straggler(self) -> Optional[dict]:
+        return self._default._last_straggler
+
+    def job(self, job_id: str) -> Optional[_jobs_mod.JobState]:
+        """The JobState for ``job_id`` (None = never opened)."""
+        with self._lock:
+            return self._jobs.get(str(job_id))
+
     def _replay(self, records) -> None:
         """Restore journaled control-plane state (constructor only,
         before the serve thread exists — no locking needed). Raw
         mutations are deliberate: replay IS the WAL API's read side
-        (lint R003 exempts ``_replay``)."""
+        (lint R003 exempts ``_replay``). Records tagged ``job`` replay
+        into that job's state; ``job_open``/``job_close`` rebuild the
+        job table itself, so a resume (or a standby promotion) re-adopts
+        EVERY live job with its own epoch."""
         from ..telemetry import skew as _skew_mod
         for kind, data in records:
+            jid = str(data.get("job", _jobs_mod.DEFAULT_JOB))
+            if kind == "job_open":
+                # a journaled open proves multi-job was on when written
+                self.multi_job = True
+                prev = self._jobs.get(jid)
+                if prev is None or not prev.open:
+                    self._jobs[jid] = _jobs_mod.JobState(
+                        jid, int(data.get("nworkers", self.nworkers)),
+                        elastic=bool(data.get("elastic", False)))
+                continue
+            if kind == "job_close":
+                closing = self._jobs.get(jid)
+                if closing is not None:
+                    closing.close(str(data.get("reason", "")))
+                continue
+            job = self._jobs.get(jid)
+            if job is None:
+                # tagged records outlived a torn job_open: the tags
+                # themselves prove the job existed — adopt it
+                self.multi_job = True
+                job = _jobs_mod.JobState(jid, self.nworkers,
+                                         elastic=self.elastic)
+                self._jobs[jid] = job
             if kind == "assign":
-                self._ranks[str(data["task"])] = int(data["rank"])
+                job._ranks[str(data["task"])] = int(data["rank"])
             elif kind == "epoch":
-                self._epoch = int(data["epoch"])
-                if self.elastic and self._member is not None:
-                    self._member.formed(data.get("members", []))
+                job._epoch = int(data["epoch"])
+                if job.elastic and job._member is not None:
+                    job._member.formed(data.get("members", []))
             elif kind == "park":
-                if self.elastic and self._member is not None:
-                    self._member.park(int(data["rank"]))
+                if job.elastic and job._member is not None:
+                    job._member.park(int(data["rank"]))
             elif kind == "evict":
-                if self.elastic and self._member is not None:
-                    self._member.evict(int(data["rank"]))
+                if job.elastic and job._member is not None:
+                    job._member.evict(int(data["rank"]))
             elif kind == "topo":
-                self._topo = dict(data.get("doc") or {})
+                job._topo = dict(data.get("doc") or {})
             elif kind == "skew":
                 digest = dict(data.get("digest") or {})
-                self._skew = digest
-                self._skew_election = _skew_mod.FleetElection.seeded(
+                job._skew = digest
+                job._skew_election = _skew_mod.FleetElection.seeded(
                     digest)
             elif kind == "endpoint":
-                self._endpoints[str(data["task"])] = dict(data["doc"])
+                job._endpoints[str(data["task"])] = dict(data["doc"])
             elif kind == "down":
-                self._shutdown_ranks.add(int(data["rank"]))
+                job._shutdown_ranks.add(int(data["rank"]))
             elif kind == "resume":
                 self.restarts = int(data.get("restarts", self.restarts))
             elif kind == _wal_mod.LEASE_KIND:
                 self._lease = dict(data)
                 self._journaled_lease = dict(data)
+        for job in self._jobs.values():
+            if job.open and job._epoch > 0:
+                job.mark_live()
 
-    def _wal(self, kind: str, **data) -> None:
+    def _wal(self, kind: str, _job=None, **data) -> None:
         """Journal one control-plane transition (no-op when the WAL is
         off). Callers invoke this BEFORE acting on the transition —
         the journal is write-ahead, so a crash between journal and
         action replays the intent, never loses it. Every journaled
         record is also published to ``repl`` subscribers as the exact
         frame bytes that hit the disk (re-encoding is byte-identical:
-        canonical JSON)."""
+        canonical JSON).
+
+        Multi-job (ISSUE 15): pass the owning JobState as ``_job``.
+        Non-default jobs get a ``job`` key stamped into the record (the
+        replay side routes on it) and the record mirrored — best-effort
+        — into the job's own ``<wal_dir>/<job_id>/`` journal for
+        isolated inspection. Default-job records stay untagged, so a
+        multi-job-off WAL is byte-identical to the single-job one."""
         if self._wal_log is None:
             return
+        jid = None
+        if self.multi_job:
+            if _job is not None and \
+                    _job.job_id != _jobs_mod.DEFAULT_JOB:
+                jid = _job.job_id
+                data = dict(data)
+                data["job"] = jid
+            elif str(data.get("job", _jobs_mod.DEFAULT_JOB)) \
+                    != _jobs_mod.DEFAULT_JOB:
+                jid = str(data["job"])      # job_open / job_close
         with self._repl_cv:
             if kind == _wal_mod.LEASE_KIND and \
                     _wal_mod.lease_renewal_only(self._journaled_lease,
@@ -447,6 +575,30 @@ class Tracker:
                 self._journaled_lease = dict(data)
             self._repl_log.append(_wal_mod.encode_record(seq, kind, data))
             self._repl_cv.notify_all()
+            if jid is not None:
+                self._mirror_job_record_locked(jid, kind, data)
+
+    def _mirror_job_record_locked(self, jid: str, kind: str,
+                           data: dict) -> None:
+        """Best-effort per-job journal mirror under ``_repl_cv`` (the
+        caller, ``_wal``). The root journal is authoritative — a mirror
+        that cannot open or append is dropped silently rather than ever
+        failing a control-plane transition."""
+        try:
+            w = self._job_wals.get(jid)
+            if w is None and kind != "job_close":
+                w = _wal_mod.WriteAheadLog(
+                    os.path.join(self.wal_dir, jid))
+                w.open(resume=os.path.exists(w.path))
+                self._job_wals[jid] = w
+            if w is not None:
+                w.record(kind,
+                         **{k: v for k, v in data.items() if k != "job"})
+                if kind == "job_close":
+                    w.close()
+                    self._job_wals.pop(jid, None)
+        except Exception:  # pragma: no cover - mirror is best-effort
+            pass
 
     def _note_resume(self, nrecords: int) -> None:
         """Make a tracker resume observable: span + counter + flight
@@ -457,12 +609,15 @@ class Tracker:
         telemetry.record_span("tracker.resume", 0.0, op="resume",
                               provenance="tracker",
                               records=nrecords, restarts=self.restarts)
+        live_jobs = [j.job_id for j in self._jobs.values() if j.open]
+        jobs_note = (f", jobs {sorted(live_jobs)}" if self.multi_job
+                     else "")
         flight.note("tracker_resume",
                     f"replayed {nrecords} WAL records, restart "
-                    f"#{self.restarts}, epoch {self._epoch}")
+                    f"#{self.restarts}, epoch {self._epoch}{jobs_note}")
         print(f"[tracker] resumed from WAL ({nrecords} records, "
               f"restart #{self.restarts}, epoch {self._epoch}, "
-              f"{len(self._ranks)} known ranks)",
+              f"{len(self._ranks)} known ranks{jobs_note})",
               file=sys.stderr, flush=True)
 
     def wal_records(self) -> int:
@@ -610,8 +765,10 @@ class Tracker:
         # can be poisoned by its service going away; snapshot under the
         # lock, shut down outside it (shutdown() can block on joins)
         with self._lock:
-            services = list(self._services)
-            self._services.clear()
+            services = []
+            for jb in self._jobs.values():
+                services.extend(jb._services)
+                jb._services = []
         for _epoch, svc in services:
             try:
                 svc.shutdown()
@@ -619,6 +776,14 @@ class Tracker:
                 pass
         if self._wal_log is not None and not self.crashed:
             self._wal_log.close()
+            with self._repl_cv:
+                mirrors = list(self._job_wals.values())
+                self._job_wals.clear()
+            for w in mirrors:
+                try:
+                    w.close()
+                except Exception:  # pragma: no cover - best-effort
+                    pass
 
     def crash(self) -> None:
         """Simulate a tracker crash (tests, chaos ``tracker_kill``):
@@ -642,17 +807,18 @@ class Tracker:
             self.sock.close()
         except OSError:
             pass
-        # deliberately NOT closed/reaped: the WAL file handle and any
+        # deliberately NOT closed/reaped: the WAL file handles and any
         # coordination services — a real crash wouldn't either
         with self._cv:
             self._cv.notify_all()  # unblock parked joiners
 
     def service_count(self) -> int:
-        """Live coordination services (bounded: old epochs are reaped)."""
+        """Live coordination services across every job (bounded: old
+        epochs are reaped per job)."""
         with self._lock:
-            return len(self._services)
+            return sum(len(jb._services) for jb in self._jobs.values())
 
-    def _new_coordinator(self, epoch: int,
+    def _new_coordinator(self, job, epoch: int,
                          world: Optional[int] = None) -> Tuple[str, int]:
         """Start this epoch's coordination service on a fresh port,
         sized to the epoch's world (an elastic epoch may be smaller
@@ -686,26 +852,27 @@ class Tracker:
                 # detection is the socket control plane's job
                 svc = compat.start_service(
                     fmt.format(p=port),
-                    self.nworkers if world is None else world)
+                    job.nworkers if world is None else world)
             except Exception as e:  # noqa: BLE001 - retried on next family
                 last_err = e
                 continue
             with self._lock:
-                self._services.append((epoch, svc))
+                job._services.append((epoch, svc))
             return (self.host, port)
         raise RuntimeError(
             f"could not start device-world coordination service: {last_err}")
 
-    def _reap_old_services(self, acked_epoch: int) -> None:
+    def _reap_old_services(self, job, acked_epoch: int) -> None:
         """Drop services older than the epoch whose members ALL acked:
         the teardown-before-ack contract guarantees no live client of an
         older epoch exists, so shutting their services down cannot poison
         anyone. Keeps service/port/thread count bounded regardless of
-        failure count (VERDICT r2 weak #5)."""
+        failure count (VERDICT r2 weak #5). Per job: one job's reap
+        never touches a neighbor's services."""
         with self._lock:
-            keep = [(e, s) for e, s in self._services if e >= acked_epoch]
-            dead = [(e, s) for e, s in self._services if e < acked_epoch]
-            self._services = keep
+            keep = [(e, s) for e, s in job._services if e >= acked_epoch]
+            dead = [(e, s) for e, s in job._services if e < acked_epoch]
+            job._services = keep
         for _e, svc in dead:
             try:
                 svc.shutdown()
@@ -714,9 +881,18 @@ class Tracker:
 
     def merged_metrics(self) -> Optional[dict]:
         """Fleet-merged ``telemetry_fleet`` doc from the per-rank
-        summaries shipped so far, or None when no worker shipped any."""
+        summaries shipped so far, or None when no worker shipped any.
+        Multi-job: the union across jobs, task keys re-qualified as
+        ``<job>/<task>`` so same-named tasks in different jobs cannot
+        collide."""
         with self._lock:
-            snap = dict(self._metrics)
+            if self.multi_job:
+                snap = {}
+                for jid, jb in self._jobs.items():
+                    for t, d in jb._metrics.items():
+                        snap[_jobs_mod.job_task(jid, t)] = d
+            else:
+                snap = dict(self._default._metrics)
         if not snap:
             return None
         return merge_summaries(snap)
@@ -730,6 +906,8 @@ class Tracker:
             return
         from ..telemetry import live
         identity = {"role": "tracker", "nworkers": self.nworkers}
+        if self.multi_job:
+            identity["multi_job"] = True
         if self.lease_ms:
             # the supervisor's pre-respawn probe and the worker-side
             # failover discovery both read this: a tracker that answers
@@ -746,7 +924,8 @@ class Tracker:
                 summary_fn=lambda: self.merged_metrics() or {},
                 gauges_fn=self._live_gauges,
                 identity=identity,
-                routes={"/straggler": self._straggler_doc},
+                routes={"/straggler": self._straggler_doc,
+                        "/jobs": self._jobs_doc},
             ).start()
         except OSError as e:
             print(f"[tracker] metrics server failed to bind port "
@@ -762,25 +941,65 @@ class Tracker:
             target=self._poll_loop, name="rabit-tracker-poll", daemon=True)
         self._poll_thread.start()
 
+    def _jl(self, jid: str, **labels) -> Dict[str, str]:
+        """Gauge labels for one job's row: a ``job`` label only when
+        multi-job is on, so a single-job /metrics page is byte-identical
+        to the pre-ISSUE-15 exposition."""
+        if self.multi_job:
+            labels["job"] = jid
+        return labels
+
     def _metric_sources(self) -> list:
         """One Prometheus source per polled rank: the per-rank summary
-        labelled with its rank, so one tracker scrape shows every
-        rank's collective counters side by side."""
+        labelled with its rank (and its job when multi-job), so one
+        tracker scrape shows every rank's collective counters side by
+        side."""
         with self._lock:
-            docs = list(self._metrics.values())
-        return [({"rank": str(doc.get("rank", -1))}, doc) for doc in docs]
+            jobs_now = (list(self._jobs.values()) if self.multi_job
+                        else [self._default])
+            rows = []
+            for jb in jobs_now:
+                for doc in jb._metrics.values():
+                    rows.append((self._jl(jb.job_id,
+                                          rank=str(doc.get("rank", -1))),
+                                 doc))
+        return rows
+
+    def _job_snapshots_locked(self) -> List[dict]:
+        """Per-job gauge snapshot taken under the lock: one dict per
+        job (exactly the default job when multi-job is off)."""
+        jobs_now = (list(self._jobs.values()) if self.multi_job
+                    else [self._default])
+        snap = []
+        for jb in jobs_now:
+            m = jb._member
+            snap.append({
+                "id": jb.job_id, "status": jb.status,
+                "elastic": jb.elastic,
+                "nend": len(jb._endpoints),
+                "topo": dict(jb._topo), "skew": dict(jb._skew),
+                "strag": jb._last_straggler,
+                "world": (m.world() if jb.elastic and m is not None
+                          else jb.nworkers),
+                "evictions": (m.evictions if jb.elastic and m is not None
+                              else 0),
+                "admissions": (m.admissions
+                               if jb.elastic and m is not None else 0),
+                "quarantined": jb.quarantined,
+            })
+        return snap
 
     def _live_gauges(self) -> list:
         with self._lock:
-            nend = len(self._endpoints)
+            snap = self._job_snapshots_locked()
             polls = self._poll_count
-            strag = self._last_straggler
-            topo = dict(self._topo)
-            skew_doc = dict(self._skew)
+            queued_total = self._admission.queued_total
+            shed_total = self._admission.shed_total
+        qdepth = len(self._admission)
         gauges = [
             ("rabit_tracker_endpoints",
              "Worker metrics endpoints known to the tracker.",
-             "gauge", [({}, nend)]),
+             "gauge", [(self._jl(s["id"]), s["nend"]) for s in snap]),
             ("rabit_tracker_polls_total",
              "Completed endpoint poll sweeps.", "counter", [({}, polls)]),
         ]
@@ -811,68 +1030,136 @@ class Tracker:
                 "Journaled records not yet acked by the standby — the "
                 "bounded data loss of a failover right now.",
                 "gauge", [({}, repl["lag_records"])]))
-        if self.elastic:
-            with self._lock:
-                world_now = self._member.world()
-                evs, adms = (self._member.evictions,
-                             self._member.admissions)
+        elastic_rows = [s for s in snap if s["elastic"]]
+        if elastic_rows:
             gauges.append((
                 "rabit_world_size",
                 "Live world size of the current membership epoch "
                 "(elastic jobs shrink below the launch target and "
                 "grow back on re-admission).", "gauge",
-                [({}, world_now)]))
+                [(self._jl(s["id"]), s["world"]) for s in elastic_rows]))
             gauges.append((
                 "rabit_member_evictions_total",
                 "Ranks evicted from the live job (watchdog/poll "
                 "evidence or the evict command).", "counter",
-                [({}, evs)]))
+                [(self._jl(s["id"]), s["evictions"])
+                 for s in elastic_rows]))
             gauges.append((
                 "rabit_member_admissions_total",
                 "Parked joiners admitted at an epoch boundary.",
-                "counter", [({}, adms)]))
-        if topo.get("groups"):
-            sizes = [len(g) for g in topo["groups"]]
+                "counter", [(self._jl(s["id"]), s["admissions"])
+                            for s in elastic_rows]))
+        topo_rows = [s for s in snap if s["topo"].get("groups")]
+        if topo_rows:
             gauges.append((
                 "rabit_tracker_topology_hosts",
                 "Distinct hosts in the current link-registration epoch.",
-                "gauge", [({}, len(sizes))]))
+                "gauge",
+                [(self._jl(s["id"]), len(s["topo"]["groups"]))
+                 for s in topo_rows]))
+            rows = []
+            for s in topo_rows:
+                sizes = [len(g) for g in s["topo"]["groups"]]
+                rows.append((self._jl(s["id"], stat="min"), min(sizes)))
+                rows.append((self._jl(s["id"], stat="max"), max(sizes)))
             gauges.append((
                 "rabit_tracker_topology_ranks_per_host",
                 "Ranks per host (max label distinguishes ragged "
                 "groupings, which disable the hierarchical schedule).",
-                "gauge", [({"stat": "min"}, min(sizes)),
-                          ({"stat": "max"}, max(sizes))]))
-        if strag is not None and strag.get("lagging_rank") is not None:
+                "gauge", rows))
+        strag_rows = [s for s in snap
+                      if s["strag"] is not None
+                      and s["strag"].get("lagging_rank") is not None]
+        if strag_rows:
             gauges.append((
                 "rabit_straggler_lag_collectives",
                 "Collectives the laggard is behind the leader.", "gauge",
-                [({"rank": str(strag["lagging_rank"])},
-                  strag["lag_collectives"])]))
+                [(self._jl(s["id"],
+                           rank=str(s["strag"]["lagging_rank"])),
+                  s["strag"]["lag_collectives"]) for s in strag_rows]))
             gauges.append((
                 "rabit_straggler_busy_skew_seconds",
                 "Spread of per-rank collective busy time.", "gauge",
-                [({}, strag["busy_skew_s"])]))
-        if skew_doc.get("offsets_ms"):
+                [(self._jl(s["id"]), s["strag"]["busy_skew_s"])
+                 for s in strag_rows]))
+        skew_rows = [s for s in snap if s["skew"].get("offsets_ms")]
+        if skew_rows:
+            offset_rows = []
+            for s in skew_rows:
+                offset_rows.extend(
+                    (self._jl(s["id"], rank=str(r)), v)
+                    for r, v in sorted(s["skew"]["offsets_ms"].items(),
+                                       key=lambda kv: int(kv[0])))
             gauges.append((
                 "rabit_skew_offset_ms",
                 "Per-rank mean arrival offset behind the earliest rank "
                 "(the skew digest served to workers).", "gauge",
-                [({"rank": str(r)}, v)
-                 for r, v in sorted(skew_doc["offsets_ms"].items(),
-                                    key=lambda kv: int(kv[0]))]))
+                offset_rows))
             gauges.append((
                 "rabit_skew_epoch",
                 "Fleet skew election epoch (bumps when the served "
                 "laggard verdict changes).",
-                "gauge", [({}, skew_doc.get("epoch", 0))]))
+                "gauge", [(self._jl(s["id"]), s["skew"].get("epoch", 0))
+                          for s in skew_rows]))
+        if self.multi_job:
+            by_status: Dict[str, int] = {}
+            for s in snap:
+                by_status[s["status"]] = by_status.get(s["status"], 0) + 1
+            gauges.append((
+                "rabit_tracker_jobs",
+                "Jobs known to this tracker by lifecycle status "
+                "(forming/live/failed/closed).", "gauge",
+                [({"status": st}, n)
+                 for st, n in sorted(by_status.items())]))
+            gauges.append((
+                "rabit_admission_queue_depth",
+                "Submitted jobs parked in the bounded FIFO admission "
+                "queue right now.", "gauge", [({}, qdepth)]))
+            gauges.append((
+                "rabit_admission_queued_total",
+                "Job submissions parked (they retry after the hinted "
+                "backoff).", "counter", [({}, queued_total)]))
+            gauges.append((
+                "rabit_admission_shed_total",
+                "Job submissions shed past the queue depth — overload "
+                "answered with backoff, never a stall.", "counter",
+                [({}, shed_total)]))
+            gauges.append((
+                "rabit_job_quarantined_total",
+                "Commands quarantined at this job's fault boundary "
+                "(exceptions that never reached the accept loop).",
+                "counter", [(self._jl(s["id"]), s["quarantined"])
+                            for s in snap]))
         return gauges
 
     def _straggler_doc(self) -> dict:
+        """The ``/straggler`` route: the default job's snapshot (shape
+        unchanged from the single-job tracker) plus — multi-job — a
+        ``jobs`` map of every job's own snapshot."""
         with self._lock:
-            strag = self._last_straggler
-        return strag if strag is not None else {"ranks": [],
-                                                "signal": False}
+            strag = self._default._last_straggler
+            per_job = ({jb.job_id: jb._last_straggler
+                        for jb in self._jobs.values()
+                        if jb._last_straggler is not None}
+                       if self.multi_job else None)
+        doc = (dict(strag) if strag is not None
+               else {"ranks": [], "signal": False})
+        if per_job is not None:
+            doc["jobs"] = per_job
+        return doc
+
+    def _jobs_doc(self) -> dict:
+        """The ``/jobs`` route: per-job health + the admission plane
+        (capture_status.py --live renders this)."""
+        with self._lock:
+            docs = [jb.doc() for jb in self._jobs.values()]
+            queued_total = self._admission.queued_total
+            shed_total = self._admission.shed_total
+        return {"multi_job": bool(self.multi_job), "jobs": docs,
+                "queue": self._admission.snapshot(),
+                "queued_total": queued_total, "shed_total": shed_total,
+                "max_jobs": self._max_jobs,
+                "max_fleet_ranks": self._max_fleet_ranks}
 
     def _poll_loop(self) -> None:
         from ..telemetry import crossrank, live, skew
@@ -880,81 +1167,96 @@ class Tracker:
         since_snapshot = 0
         while not self._poll_stop.wait(interval):
             with self._lock:
-                endpoints = dict(self._endpoints)
-            if not endpoints:
-                continue
-            for tid, ep in endpoints.items():
-                doc = live.scrape_json(ep["host"], ep["port"])
-                if doc is not None:
-                    with self._lock:
-                        self._metrics[tid] = doc
-                        self._endpoint_misses[tid] = 0
-                    continue
-                # post-resume grace (ISSUE 10): right after a tracker
-                # resume every poller in the fleet is still timing out
-                # against the OLD incarnation's cadence — silence here
-                # is evidence of the tracker's outage, not the
-                # worker's. Waive it until the grace window closes.
-                if self.in_resume_grace():
-                    with self._lock:
-                        self._endpoint_misses[tid] = 0
-                    continue
-                # poll evidence of a partition: an endpoint that HAS
-                # answered before and now stays silent for several
-                # sweeps is indistinguishable from a dead rank to the
-                # fleet — in an elastic world that is grounds for
-                # eviction (the watchdog catches the same failure from
-                # the inside; this catches it when the process is
-                # unreachable rather than crashed)
-                with self._lock:
-                    seen_before = tid in self._metrics
-                    misses = self._endpoint_misses.get(tid, 0) + 1
-                    self._endpoint_misses[tid] = misses
-                    rank = self._ranks.get(tid)
-                    live_rank = (self.elastic and rank is not None
-                                 and rank in self._member.live)
-                if (self.elastic and seen_before and live_rank
-                        and misses >= _membership.EVICT_POLL_MISSES):
-                    self.evict_rank(
-                        rank, f"endpoint silent for {misses} polls")
-            with self._lock:
-                summaries = dict(self._metrics)
-                self._poll_count += 1
-                served_epoch = self._skew.get("epoch")
-            strag = crossrank.straggler_snapshot(summaries)
-            # raw per-sweep offsets fold through the ONE fleet-wide
-            # election; the served digest is its smoothed, hysteretic
-            # verdict with an epoch that bumps on election change
-            raw = skew.digest_from_snapshot(strag)
-            if self._skew_election is None:
-                # poll thread is the sole writer after _replay seeding
-                self._skew_election = skew.FleetElection()  # noqa: C003
-            digest = self._skew_election.fold(raw)
-            if digest is not None and \
-                    digest.get("epoch") != served_epoch:
-                # journal VERDICTS, not sweeps: the digest's epoch
-                # bumps exactly when the election changes, so the WAL
-                # grows with decisions rather than with poll cadence
-                self._wal("skew", digest=digest)
-            with self._lock:
-                self._last_straggler = strag
-                if digest is not None:
-                    self._skew = digest
-            # periodic straggler snapshot: one line every ~5 sweeps,
-            # only while someone is actually behind — in the round
-            # sequence, or >1s of accumulated in-collective wait
+                jobs_now = [jb for jb in self._jobs.values() if jb.open]
+            polled = False
             since_snapshot += 1
-            # the snapshot's signal verdict carries the same threshold
-            # this print used to re-derive (crossrank.BUSY_SKEW_SIGNAL_S)
-            behind = bool(strag.get("signal")) \
-                and strag.get("lagging_rank") is not None
-            if since_snapshot >= 5 and behind:
-                since_snapshot = 0
-                print(f"[tracker] straggler: rank "
-                      f"{strag['lagging_rank']} is "
-                      f"{strag['lag_collectives']} collectives behind "
-                      f"(busy skew {strag['busy_skew_s']:.3f}s)",
-                      file=sys.stderr, flush=True)
+            for job in jobs_now:
+                with self._lock:
+                    endpoints = dict(job._endpoints)
+                if not endpoints:
+                    continue
+                polled = True
+                for tid, ep in endpoints.items():
+                    doc = live.scrape_json(ep["host"], ep["port"])
+                    if doc is not None:
+                        with self._lock:
+                            job._metrics[tid] = doc
+                            job._endpoint_misses[tid] = 0
+                        continue
+                    # post-resume grace (ISSUE 10): right after a
+                    # tracker resume every poller in the fleet is still
+                    # timing out against the OLD incarnation's cadence
+                    # — silence here is evidence of the tracker's
+                    # outage, not the worker's. Waive it until the
+                    # grace window closes.
+                    if self.in_resume_grace():
+                        with self._lock:
+                            job._endpoint_misses[tid] = 0
+                        continue
+                    # poll evidence of a partition: an endpoint that
+                    # HAS answered before and now stays silent for
+                    # several sweeps is indistinguishable from a dead
+                    # rank to the fleet — in an elastic world that is
+                    # grounds for eviction (the watchdog catches the
+                    # same failure from the inside; this catches it
+                    # when the process is unreachable rather than
+                    # crashed). Scoped to the silent endpoint's OWN
+                    # job: one job's dead fleet never evicts a
+                    # neighbor's rank.
+                    with self._lock:
+                        seen_before = tid in job._metrics
+                        misses = job._endpoint_misses.get(tid, 0) + 1
+                        job._endpoint_misses[tid] = misses
+                        rank = job._ranks.get(tid)
+                        live_rank = (job.elastic and rank is not None
+                                     and rank in job._member.live)
+                    if (job.elastic and seen_before and live_rank
+                            and misses >= _membership.EVICT_POLL_MISSES):
+                        self.evict_rank(
+                            rank, f"endpoint silent for {misses} polls",
+                            job=job)
+                with self._lock:
+                    summaries = dict(job._metrics)
+                    served_epoch = job._skew.get("epoch")
+                strag = crossrank.straggler_snapshot(summaries)
+                # raw per-sweep offsets fold through the job's OWN
+                # election; the served digest is its smoothed,
+                # hysteretic verdict with an epoch that bumps on
+                # election change (one election per job — job B's
+                # laggard must never skew job A's schedules)
+                raw = skew.digest_from_snapshot(strag)
+                if job._skew_election is None:
+                    # poll thread is the sole writer after _replay
+                    job._skew_election = skew.FleetElection()
+                digest = job._skew_election.fold(raw)
+                if digest is not None and \
+                        digest.get("epoch") != served_epoch:
+                    # journal VERDICTS, not sweeps: the digest's epoch
+                    # bumps exactly when the election changes, so the
+                    # WAL grows with decisions rather than poll cadence
+                    self._wal("skew", digest=digest, _job=job)
+                with self._lock:
+                    job._last_straggler = strag
+                    if digest is not None:
+                        job._skew = digest
+                # periodic straggler snapshot: one line every ~5
+                # sweeps, only while someone is actually behind — in
+                # the round sequence, or >1s of in-collective wait
+                behind = bool(strag.get("signal")) \
+                    and strag.get("lagging_rank") is not None
+                if since_snapshot >= 5 and behind:
+                    since_snapshot = 0
+                    jtag = (f" job {job.job_id}" if self.multi_job
+                            else "")
+                    print(f"[tracker] straggler:{jtag} rank "
+                          f"{strag['lagging_rank']} is "
+                          f"{strag['lag_collectives']} collectives "
+                          f"behind (busy skew "
+                          f"{strag['busy_skew_s']:.3f}s)",
+                          file=sys.stderr, flush=True)
+            if polled:
+                with self._lock:
+                    self._poll_count += 1
 
     def live_addr(self) -> Optional[Tuple[str, int]]:
         """The live /healthz endpoint's ``(host, port)``, or None when
@@ -966,20 +1268,30 @@ class Tracker:
     def live_stats(self) -> dict:
         """Snapshot of the live plane for launchers and tests."""
         with self._lock:
-            return {
+            stats = {
                 "metrics_addr": (None if self._metrics_server is None
                                  else list(self._metrics_server.address)),
                 "endpoints": {t: dict(e) for t, e in
-                              self._endpoints.items()},
+                              self._default._endpoints.items()},
                 "polls": self._poll_count,
-                "straggler": self._last_straggler,
+                "straggler": self._default._last_straggler,
             }
+            if self.multi_job:
+                stats["jobs"] = {jb.job_id: jb.doc()
+                                 for jb in self._jobs.values()}
+        return stats
 
-    def _print_fleet_metrics(self) -> None:
+    def _print_fleet_metrics(self, job=None) -> None:
         """End-of-run fleet table — the production replacement for
         eyeballing per-rank TrackerPrint lines. Appended to
-        ``messages`` like a print command so launchers/tests see it."""
-        fleet = self.merged_metrics()
+        ``messages`` like a print command so launchers/tests see it.
+        Multi-job: scoped to the completing job's own ranks."""
+        if job is None or not self.multi_job:
+            fleet = self.merged_metrics()
+        else:
+            with self._lock:
+                snap = dict(job._metrics)
+            fleet = merge_summaries(snap) if snap else None
         if fleet is None or not fleet.get("counters"):
             return
         table = format_fleet_table(fleet)
@@ -1014,6 +1326,12 @@ class Tracker:
             t.start()
 
     def _handle(self, conn: socket.socket) -> None:
+        """Preamble parse + job routing. Job-scoped command handling
+        lives in ``_dispatch``; any exception it raises (a malformed
+        payload, a poisoned JobState) is caught HERE at the job
+        boundary and quarantined — it must never unwind into the
+        accept loop or take a neighbor job down with it."""
+        job_id = _jobs_mod.DEFAULT_JOB
         try:
             magic = _recv_u32(conn)
             if magic != MAGIC:
@@ -1022,165 +1340,361 @@ class Tracker:
             cmd = _recv_str(conn)
             task_id = _recv_str(conn)
             _recv_u32(conn)  # num_attempt (informational)
-            if cmd == "print":
-                msg = _recv_str(conn)
-                self.messages.append(msg)
-                print(msg, flush=True)
-                _send_u32(conn, 1)
-                conn.close()
-            elif cmd == "metrics":
-                payload = _recv_str(conn)
+            if self.multi_job:
+                job_id, task_id = _jobs_mod.split_task(task_id)
+            try:
+                self._dispatch(conn, cmd, job_id, task_id)
+            except (ConnectionError, OSError, struct.error):
+                raise   # wire-level failures are the peer's problem
+            except Exception as e:  # noqa: BLE001 - job fault boundary
+                self._quarantine(job_id, cmd, e)
                 try:
-                    doc = json.loads(payload)
-                except ValueError:
-                    doc = None
-                if isinstance(doc, dict):
-                    with self._lock:
-                        self._metrics[task_id] = doc
-                _send_u32(conn, 1 if isinstance(doc, dict) else 0)
-                conn.close()
-            elif cmd == "endpoint":
-                payload = _recv_str(conn)
-                try:
-                    doc = json.loads(payload)
-                except ValueError:
-                    doc = None
-                ok = (isinstance(doc, dict) and "host" in doc
-                      and "port" in doc)
-                if ok:
-                    ep = {"host": str(doc["host"]),
-                          "port": int(doc["port"]),
-                          "rank": int(doc.get("rank", -1))}
-                    self._wal("endpoint", task=task_id, doc=ep)
-                    with self._lock:
-                        self._endpoints[task_id] = ep
-                        # a re-announce is proof of life: a stale miss
-                        # count from before a tracker outage must not
-                        # carry over into fresh eviction evidence
-                        self._endpoint_misses[task_id] = 0
-                _send_u32(conn, 1 if ok else 0)
-                conn.close()
-            elif cmd == "topo":
-                with self._lock:
-                    doc = dict(self._topo)
-                _send_str(conn, json.dumps(doc))
-                conn.close()
-            elif cmd == "skew":
-                with self._lock:
-                    doc = dict(self._skew)
-                _send_str(conn, json.dumps(doc))
-                conn.close()
-            elif cmd == "world":
-                _send_str(conn, json.dumps(self.membership_doc()))
-                conn.close()
-            elif cmd == "resume":
-                # post-restart handshake (ISSUE 10): a live worker
-                # re-presents its (task_id, stable_rank, epoch) so the
-                # resumed tracker can reconcile the replayed WAL
-                # against the world that kept running through the
-                # outage. Ack 1 = identities agree (or were adopted),
-                # 0 = mismatch — the worker should fall back to a full
-                # re-registration.
-                payload = _recv_str(conn)
-                try:
-                    doc = json.loads(payload)
-                except ValueError:
-                    doc = None
-                ok = False
-                if isinstance(doc, dict) and doc.get("rank") is not None:
-                    ok = self._resume_present(
-                        task_id, int(doc["rank"]),
-                        int(doc.get("epoch", 0)))
-                _send_u32(conn, 1 if ok else 0)
-                conn.close()
-            elif cmd == "evict":
-                payload = _recv_str(conn)
-                try:
-                    doc = json.loads(payload)
-                except ValueError:
-                    doc = None
-                ok = False
-                if isinstance(doc, dict) and doc.get("rank") is not None:
-                    ok = self.evict_rank(int(doc["rank"]),
-                                         str(doc.get("reason", "")))
-                _send_u32(conn, 1 if ok else 0)
-                conn.close()
-            elif cmd == "repl":
-                self._serve_repl(conn, task_id)
-            elif cmd == "join":
-                host = _recv_str(conn)
-                port = _recv_u32(conn)
-                flags = _recv_u32(conn)
-                token = _recv_str(conn)
-                self._register(conn, task_id, host, port, flags, token,
-                               join=True)
-            elif cmd == "shutdown":
-                with self._lock:
-                    rank = self._ranks.get(task_id)
-                    if rank is not None:
-                        # journaled so a tracker resumed mid-teardown
-                        # still sees the job complete (a worker only
-                        # ever sends shutdown once)
-                        self._wal("down", rank=rank)
-                        self._shutdown_ranks.add(rank)
-                    # an elastic job is done when the LIVE world is
-                    # down — evicted ranks never send shutdown
-                    if self.elastic and self._member.live:
-                        all_down = (self._member.live
-                                    <= self._shutdown_ranks)
-                    else:
-                        all_down = (len(self._shutdown_ranks)
-                                    >= self.nworkers)
-                _send_u32(conn, 1)
-                conn.close()
-                if all_down:
-                    self._print_fleet_metrics()
-                    self._done.set()
-            elif cmd in ("start", "recover"):
-                host = _recv_str(conn)
-                port = _recv_u32(conn)
-                flags = _recv_u32(conn)
-                token = _recv_str(conn)
-                self._register(conn, task_id, host, port, flags, token)
-            else:
-                conn.close()
+                    conn.close()
+                except OSError:
+                    pass
         except (ConnectionError, OSError, struct.error):
             try:
                 conn.close()
             except OSError:
                 pass
 
-    def _expected_ranks(self) -> set:
-        """Ranks the current registration batch must contain before it
-        forms (caller holds the lock): the fixed world, or — elastic —
-        the live membership view's survivors plus parked joiners."""
-        if self.elastic:
-            return self._member.expected()
-        return set(range(self.nworkers))
+    def _dispatch(self, conn: socket.socket, cmd: str, job_id: str,
+                  task_id: str) -> None:
+        if cmd == "print":
+            msg = _recv_str(conn)
+            self.messages.append(msg)
+            print(msg, flush=True)
+            _send_u32(conn, 1)
+            conn.close()
+        elif cmd == "metrics":
+            payload = _recv_str(conn)
+            try:
+                doc = json.loads(payload)
+            except ValueError:
+                doc = None
+            job = self._job_for(job_id)
+            ok = isinstance(doc, dict) and job is not None
+            if ok:
+                with self._lock:
+                    job._metrics[task_id] = doc
+            _send_u32(conn, 1 if ok else 0)
+            conn.close()
+        elif cmd == "endpoint":
+            payload = _recv_str(conn)
+            try:
+                doc = json.loads(payload)
+            except ValueError:
+                doc = None
+            job = self._job_for(job_id)
+            ok = (isinstance(doc, dict) and "host" in doc
+                  and "port" in doc and job is not None)
+            if ok:
+                ep = {"host": str(doc["host"]),
+                      "port": int(doc["port"]),
+                      "rank": int(doc.get("rank", -1))}
+                self._wal("endpoint", task=task_id, doc=ep, _job=job)
+                with self._lock:
+                    job._endpoints[task_id] = ep
+                    # a re-announce is proof of life: a stale miss
+                    # count from before a tracker outage must not
+                    # carry over into fresh eviction evidence
+                    job._endpoint_misses[task_id] = 0
+            _send_u32(conn, 1 if ok else 0)
+            conn.close()
+        elif cmd == "topo":
+            job = self._job_for(job_id)
+            with self._lock:
+                doc = {} if job is None else dict(job._topo)
+            _send_str(conn, json.dumps(doc))
+            conn.close()
+        elif cmd == "skew":
+            job = self._job_for(job_id)
+            with self._lock:
+                doc = {} if job is None else dict(job._skew)
+            _send_str(conn, json.dumps(doc))
+            conn.close()
+        elif cmd == "world":
+            _send_str(conn, json.dumps(
+                self.membership_doc(self._job_for(job_id))))
+            conn.close()
+        elif cmd == "resume":
+            # post-restart handshake (ISSUE 10): a live worker
+            # re-presents its (task_id, stable_rank, epoch) so the
+            # resumed tracker can reconcile the replayed WAL
+            # against the world that kept running through the
+            # outage. Ack 1 = identities agree (or were adopted),
+            # 0 = mismatch — the worker should fall back to a full
+            # re-registration.
+            payload = _recv_str(conn)
+            try:
+                doc = json.loads(payload)
+            except ValueError:
+                doc = None
+            job = self._job_for(job_id)
+            ok = False
+            if isinstance(doc, dict) and doc.get("rank") is not None \
+                    and job is not None:
+                ok = self._resume_present(
+                    job, task_id, int(doc["rank"]),
+                    int(doc.get("epoch", 0)))
+            _send_u32(conn, 1 if ok else 0)
+            conn.close()
+        elif cmd == "evict":
+            payload = _recv_str(conn)
+            try:
+                doc = json.loads(payload)
+            except ValueError:
+                doc = None
+            job = self._job_for(job_id)
+            ok = False
+            if isinstance(doc, dict) and doc.get("rank") is not None \
+                    and job is not None:
+                ok = self.evict_rank(int(doc["rank"]),
+                                     str(doc.get("reason", "")),
+                                     job=job)
+            _send_u32(conn, 1 if ok else 0)
+            conn.close()
+        elif cmd == "repl":
+            self._serve_repl(conn, task_id)
+        elif cmd == "submit":
+            # admission control: answer IMMEDIATELY with a verdict
+            # (admitted / queued+retry_after / shed+retry_after) —
+            # overload sheds, it never stalls a submitter's socket
+            payload = _recv_str(conn)
+            _send_str(conn, json.dumps(self._submit(payload)))
+            conn.close()
+        elif cmd == "join":
+            host = _recv_str(conn)
+            port = _recv_u32(conn)
+            flags = _recv_u32(conn)
+            token = _recv_str(conn)
+            job = self._job_for_register(job_id)
+            if job is None:
+                conn.close()   # admission refused: shed, never parked
+                return
+            self._register(conn, job, task_id, host, port, flags,
+                           token, join=True)
+        elif cmd == "shutdown":
+            job = self._job_for(job_id)
+            all_down = False
+            if job is not None:
+                with self._lock:
+                    rank = job._ranks.get(task_id)
+                    if rank is not None:
+                        # journaled so a tracker resumed mid-teardown
+                        # still sees the job complete (a worker only
+                        # ever sends shutdown once)
+                        self._wal("down", rank=rank, _job=job)
+                        job._shutdown_ranks.add(rank)
+                    all_down = job.all_down_locked()
+            _send_u32(conn, 1)
+            conn.close()
+            if all_down:
+                self._job_complete(job)
+        elif cmd in ("start", "recover"):
+            host = _recv_str(conn)
+            port = _recv_u32(conn)
+            flags = _recv_u32(conn)
+            token = _recv_str(conn)
+            job = self._job_for_register(job_id)
+            if job is None:
+                conn.close()   # admission refused: shed, never parked
+                return
+            self._register(conn, job, task_id, host, port, flags, token)
+        else:
+            conn.close()
 
-    def _try_complete_batch_locked(self):
+    # -- multi-job admission + fault domains (ISSUE 15) -------------------
+    def _quarantine(self, job_id: str, cmd: str, exc: Exception) -> None:
+        """One job's command handler raised: count it against THAT
+        job's fault domain and keep serving. The accept loop and every
+        neighbor job never see the exception."""
+        from .. import telemetry
+        from ..telemetry import flight
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is not None:
+                job.quarantined += 1
+        telemetry.count("tracker.quarantine", provenance="tracker")
+        flight.note("job_quarantine",
+                    f"job {job_id}: {cmd} raised "
+                    f"{type(exc).__name__}: {exc}")
+        print(f"[tracker] quarantined {cmd} for job {job_id}: "
+              f"{type(exc).__name__}: {exc}", file=sys.stderr, flush=True)
+
+    def _job_for(self, job_id: str):
+        """The named job, or None when unknown (commands for a job
+        that was never admitted answer not-ok rather than raising)."""
+        with self._lock:
+            return self._jobs.get(job_id)
+
+    def _job_for_register(self, job_id: str):
+        """Resolve a registration's job: an existing open job, the
+        always-present default job, or — multi-job — an implicit
+        admission attempt sized at the launch-time target. None means
+        admission refused (the connection is shed; the worker's
+        launcher should ``submit`` and retry after the hinted
+        backoff)."""
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is not None and job.open:
+                return job
+            if job_id == _jobs_mod.DEFAULT_JOB:
+                return self._default   # closed default re-forms in place
+            if job is not None:
+                return None   # closed job: its task ids are retired
+            if self._fits_locked(self.nworkers):
+                return self._open_job_locked(job_id, self.nworkers,
+                                             self.elastic)
+        return None
+
+    def _submit(self, payload: str) -> dict:
+        """The ``submit`` wire command's verdict. Shapes:
+        ``{"ok": 1}`` admitted (idempotent for an already-open job),
+        ``{"ok": 0, "queued": 1, "position": p, "retry_after_ms": n}``
+        parked in the FIFO queue, ``{"ok": 0, "shed": 1,
+        "retry_after_ms": n}`` queue full, ``{"ok": 0, "error": ...}``
+        never admissible."""
+        try:
+            doc = json.loads(payload)
+        except ValueError:
+            doc = None
+        if not isinstance(doc, dict) or not doc.get("job"):
+            return {"ok": 0, "error": "malformed submit payload"}
+        if not self.multi_job:
+            return {"ok": 0,
+                    "error": "multi-job disabled (rabit_multi_job unset)"}
+        job_id = str(doc["job"])
+        try:
+            n = int(doc.get("nworkers", self.nworkers))
+        except (TypeError, ValueError):
+            return {"ok": 0, "error": "nworkers must be an integer"}
+        if n < 1:
+            return {"ok": 0, "error": "nworkers must be >= 1"}
+        elastic = bool(doc.get("elastic", self.elastic))
+        retry = _jobs_mod.RETRY_AFTER_MS_DEFAULT
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is not None and job.open:
+                return {"ok": 1, "job": job_id, "already": 1}
+            if self._max_fleet_ranks and n > self._max_fleet_ranks:
+                return {"ok": 0,
+                        "error": f"nworkers {n} exceeds "
+                                 f"rabit_max_fleet_ranks "
+                                 f"{self._max_fleet_ranks}"}
+            if self._fits_locked(n):
+                self._open_job_locked(job_id, n, elastic)
+                return {"ok": 1, "job": job_id}
+            pos = self._admission.offer(
+                {"job": job_id, "nworkers": n, "elastic": elastic})
+            if pos < 0:
+                depth = len(self._admission)
+                return {"ok": 0, "shed": 1,
+                        "retry_after_ms": retry * (depth + 1)}
+            return {"ok": 0, "queued": 1, "position": pos,
+                    "retry_after_ms": retry * (pos + 1)}
+
+    def _fits_locked(self, nworkers: int) -> bool:
+        """Would a job of ``nworkers`` fit under the admission caps
+        right now? Caller holds the lock. The pre-created default job
+        does not count until it has registered anyone."""
+        open_jobs = [jb for jb in self._jobs.values()
+                     if jb.open and (jb.job_id != _jobs_mod.DEFAULT_JOB
+                                     or jb._ranks or jb._pending)]
+        if len(open_jobs) >= self._max_jobs:
+            return False
+        if self._max_fleet_ranks:
+            in_use = sum(jb.nworkers for jb in open_jobs)
+            if in_use + nworkers > self._max_fleet_ranks:
+                return False
+        return True
+
+    def _open_job_locked(self, job_id: str, nworkers: int, elastic: bool):
+        """Create + journal a job (caller holds the lock and has
+        already verified it fits)."""
+        job = _jobs_mod.JobState(job_id, nworkers, elastic=elastic)
+        self._wal("job_open", job=job_id, nworkers=int(nworkers),
+                  elastic=bool(elastic))
+        self._jobs[job_id] = job
+        return job
+
+    def _close_job_locked(self, job, reason: str) -> None:
+        if job.open:
+            self._wal("job_close", job=job.job_id, reason=reason)
+            job.close(reason)
+
+    def _admit_queued_locked(self) -> List[str]:
+        """Admit queued submissions in strict FIFO order while the
+        head fits; a too-big head blocks the queue (FIFO fairness — a
+        small job must not starve a big one forever). Caller holds the
+        lock. Returns the admitted job ids."""
+        admitted = []
+        while True:
+            head = self._admission.peek()
+            if head is None or not self._fits_locked(head["nworkers"]):
+                break
+            self._admission.pop_front()
+            self._open_job_locked(head["job"], head["nworkers"],
+                                  head["elastic"])
+            admitted.append(head["job"])
+        return admitted
+
+    def _job_complete(self, job) -> None:
+        """Every live rank of ``job`` sent shutdown: close its world,
+        admit queued jobs into the freed capacity, and — only when
+        nothing else is running or waiting — finish the tracker
+        itself."""
+        self._print_fleet_metrics(job)
+        if not self.multi_job:
+            self._done.set()
+            return
+        with self._lock:
+            self._close_job_locked(job, "complete")
+            admitted = self._admit_queued_locked()
+            still_open = [jb for jb in self._jobs.values()
+                          if jb.open and
+                          (jb.job_id != _jobs_mod.DEFAULT_JOB
+                           or jb._ranks or jb._pending)]
+            queue_empty = self._admission.peek() is None
+        for jid in admitted:
+            print(f"[tracker] admitted queued job {jid}",
+                  file=sys.stderr, flush=True)
+        if job is self._default and not still_open and queue_empty:
+            self._done.set()
+
+    def _expected_ranks(self, job) -> set:
+        """Ranks the job's current registration batch must contain
+        before it forms (caller holds the lock): the fixed world, or —
+        elastic — the live membership view's survivors plus parked
+        joiners."""
+        if job.elastic:
+            return job._member.expected()
+        return set(range(job.nworkers))
+
+    def _try_complete_batch_locked(self, job):
         """(batch, epoch) when every expected rank is pending, else
         None. Caller holds the lock and, on success, must run
         ``_assign`` OUTSIDE it. Factored out of ``_register`` because
         an EVICTION can also complete a batch: survivors re-register
         and block waiting for a dead rank until the poll loop (or an
         ``evict`` command) removes it from the expected set."""
-        expected = self._expected_ranks()
-        if not expected or not expected <= set(self._pending):
+        expected = self._expected_ranks(job)
+        if not expected or not expected <= set(job._pending):
             return None
-        batch = {r: self._pending.pop(r) for r in expected}
-        self._wal("epoch", epoch=self._epoch + 1,
-                  members=sorted(batch))
-        self._epoch += 1
-        if self.elastic:
-            admitted = self._member.formed(batch)
+        batch = {r: job._pending.pop(r) for r in expected}
+        self._wal("epoch", epoch=job._epoch + 1,
+                  members=sorted(batch), _job=job)
+        job._epoch += 1
+        job.mark_live()
+        if job.elastic:
+            admitted = job._member.formed(batch)
             for r in sorted(admitted):
                 self._note_transition("admit", r, "joined at epoch "
-                                      f"{self._epoch}")
+                                      f"{job._epoch}", job)
         self._cv.notify_all()
-        return batch, self._epoch
+        return batch, job._epoch
 
-    def _resume_present(self, task_id: str, rank: int,
+    def _resume_present(self, job, task_id: str, rank: int,
                         epoch: int) -> bool:
         """Reconcile one worker's post-restart ``resume`` handshake
         against the replayed WAL: a matching identity confirms the
@@ -1189,83 +1703,86 @@ class Tracker:
         authority on its own rank), and a contradiction is refused so
         the worker falls back to full re-registration."""
         with self._lock:
-            known = self._ranks.get(task_id)
-            if known is None and 0 <= rank < self.nworkers \
-                    and rank not in self._ranks.values():
-                self._wal("assign", task=task_id, rank=rank)
-                self._ranks[task_id] = rank
+            known = job._ranks.get(task_id)
+            if known is None and 0 <= rank < job.nworkers \
+                    and rank not in job._ranks.values():
+                self._wal("assign", task=task_id, rank=rank, _job=job)
+                job._ranks[task_id] = rank
                 known = rank
-            ok = known == rank and epoch <= self._epoch + 1
+            ok = known == rank and epoch <= job._epoch + 1
             if ok:
-                self._endpoint_misses[task_id] = 0
-                self._resumed_ranks.add(rank)
+                job._endpoint_misses[task_id] = 0
+                job._resumed_ranks.add(rank)
         return ok
 
-    def _register(self, conn, task_id: str, host: str, port: int,
+    def _register(self, conn, job, task_id: str, host: str, port: int,
                   flags: int = 0, token: str = "",
                   join: bool = False) -> None:
         grace_s: Optional[float] = None
         with self._cv:
-            if task_id not in self._ranks:
-                rank = len(self._ranks)
-                if self.elastic and rank >= self.nworkers \
-                        and self._member.evicted:
+            if task_id not in job._ranks:
+                rank = len(job._ranks)
+                if job.elastic and rank >= job.nworkers \
+                        and job._member.evicted:
                     # replacement hardware arrives under a NEW task_id:
                     # adopt the lowest vacated stable rank so the world
                     # can grow back to target (and the newcomer inherits
                     # that rank's durable checkpoint shard directory)
-                    rank = min(self._member.evicted)
-                self._wal("assign", task=task_id, rank=rank)
-                self._ranks[task_id] = rank
-            rank = self._ranks[task_id]
-            if rank >= self.nworkers:
+                    rank = min(job._member.evicted)
+                self._wal("assign", task=task_id, rank=rank, _job=job)
+                job._ranks[task_id] = rank
+            rank = job._ranks[task_id]
+            if rank >= job.nworkers:
                 conn.close()
                 return
-            if self.elastic:
-                m = self._member
+            if job.elastic:
+                m = job._member
                 if join or rank in m.evicted or \
                         (m.live and rank not in m.live):
                     # (re-)admission: parked until the epoch boundary —
                     # a joiner must never perturb an in-flight world
-                    self._wal("park", rank=rank)
+                    self._wal("park", rank=rank, _job=job)
                     m.park(rank)
                     grace_s = _membership.join_grace_ms() / 1e3 or None
-            self._shutdown_ranks.discard(rank)
-            self._pending[rank] = (conn, host, port, flags, token)
-            got = self._try_complete_batch_locked()
+            job._shutdown_ranks.discard(rank)
+            job._pending[rank] = (conn, host, port, flags, token)
+            got = self._try_complete_batch_locked(job)
             if got is None:
                 self._cv.wait_for(
-                    lambda: rank not in self._pending
+                    lambda: rank not in job._pending
                     or self._done.is_set(), timeout=grace_s)
-                if rank in self._pending and \
-                        self._pending[rank][0] is conn:
+                if rank in job._pending and \
+                        job._pending[rank][0] is conn:
                     # parked joiner outlived rabit_join_grace_ms with
                     # no epoch boundary: bounce it (the joiner retries)
                     # rather than hold its socket open forever
-                    del self._pending[rank]
+                    del job._pending[rank]
                     try:
                         conn.close()
                     except OSError:
                         pass
                 return  # the completing thread serves everyone
             batch, epoch = got
-        self._assign(batch, epoch)
+        self._assign(job, batch, epoch)
 
     # -- elastic membership (ISSUE 9) -------------------------------------
-    def membership_doc(self) -> dict:
+    def membership_doc(self, job=None) -> dict:
         """The ``world`` wire command's payload: the live membership
         view, or a static fixed-world doc when elastic is off (so the
         command always answers — a worker probing an inelastic tracker
         learns membership is fixed rather than timing out)."""
+        if job is None:
+            job = self._default
         with self._lock:
-            if self.elastic:
-                return self._member.doc(self._epoch)
-            return {"epoch": self._epoch, "world": self.nworkers,
-                    "target": self.nworkers,
-                    "live": list(range(self.nworkers)), "evicted": [],
+            if job.elastic:
+                return job._member.doc(job._epoch)
+            return {"epoch": job._epoch, "world": job.nworkers,
+                    "target": job.nworkers,
+                    "live": list(range(job.nworkers)), "evicted": [],
                     "joining": [], "generation": 0, "elastic": False}
 
-    def _note_transition(self, kind: str, rank: int, detail: str) -> None:
+    def _note_transition(self, kind: str, rank: int, detail: str,
+                         job=None) -> None:
         """Make a membership transition observable: a counter + a
         zero-duration ``membership.transition`` span (trace_report
         renders these on the timeline) + a flight-recorder note naming
@@ -1273,43 +1790,55 @@ class Tracker:
         resized."""
         from .. import telemetry
         from ..telemetry import flight
+        jtag = ""
+        if job is not None and self.multi_job \
+                and job.job_id != _jobs_mod.DEFAULT_JOB:
+            jtag = f" job {job.job_id}"
         telemetry.count(f"membership.{kind}", provenance="membership")
         telemetry.record_span("membership.transition", 0.0,
                               op=kind, provenance="membership",
                               rank=rank, detail=detail)
-        flight.note(f"member_{kind}", f"rank {rank}: {detail}")
-        print(f"[tracker] membership: {kind} rank {rank} ({detail})",
-              file=sys.stderr, flush=True)
+        flight.note(f"member_{kind}", f"rank {rank}:{jtag} {detail}")
+        print(f"[tracker] membership:{jtag} {kind} rank {rank} "
+              f"({detail})", file=sys.stderr, flush=True)
 
-    def evict_rank(self, rank: int, reason: str = "") -> bool:
+    def evict_rank(self, rank: int, reason: str = "", job=None) -> bool:
         """Evict ``rank`` from the live job (the ``evict`` wire
         command, or the poll loop's silent-endpoint evidence). The
         rank leaves the expected set immediately, so survivors already
         blocked in re-registration form their N-1 batch NOW instead of
-        waiting out the ready timeout on a dead peer. No-op unless
-        elastic."""
-        if not self.elastic or not 0 <= int(rank) < self.nworkers:
+        waiting out the ready timeout on a dead peer. No-op unless the
+        job is elastic. Scoped: evicting from one job never touches a
+        neighbor's membership."""
+        if job is None:
+            job = self._default
+        if not job.elastic or not 0 <= int(rank) < job.nworkers:
             return False
         rank = int(rank)
         with self._cv:
-            if rank in self._member.evicted:
+            if rank in job._member.evicted:
                 return False
-            self._wal("evict", rank=rank, reason=reason)
-            if not self._member.evict(rank):
+            self._wal("evict", rank=rank, reason=reason, _job=job)
+            if not job._member.evict(rank):
                 return False
-            pend = self._pending.pop(rank, None)
-            got = self._try_complete_batch_locked()
-        self._note_transition("evict", rank, reason or "evicted")
+            pend = job._pending.pop(rank, None)
+            got = self._try_complete_batch_locked(job)
+            if job.open and not job._member.live:
+                # every member is gone: the job FAILED inside its own
+                # fault domain (it re-forms if replacements arrive) —
+                # observable in /jobs, invisible to its neighbors
+                job.mark_failed()
+        self._note_transition("evict", rank, reason or "evicted", job)
         if pend is not None:
             try:
                 pend[0].close()
             except OSError:
                 pass
         if got is not None:
-            self._assign(*got)
+            self._assign(job, *got)
         return True
 
-    def _assign(self,
+    def _assign(self, job,
                 batch: Dict[int, Tuple[socket.socket, str, int, int,
                                        str]],
                 epoch: int) -> None:
@@ -1318,7 +1847,7 @@ class Tracker:
         # SLOTS, and the wire `rank` field carries the slot. With a
         # fixed world the batch is always the full contiguous range, so
         # the mapping is the identity and nothing changes byte-wise.
-        world = len(batch) if self.elastic else self.nworkers
+        world = len(batch) if job.elastic else job.nworkers
         slot_of = _membership.dense_slots(batch)
         addr = {slot_of[r]: (h, p, tok)
                 for r, (c, h, p, f, tok) in batch.items()}
@@ -1329,8 +1858,9 @@ class Tracker:
         want_coord = self._coordinator or any(
             f & FLAG_DATAPLANE for (c, h, p, f, tok) in batch.values())
         try:
-            coord_host, coord_port = (self._new_coordinator(epoch, world)
-                                      if want_coord else ("", 0))
+            coord_host, coord_port = (
+                self._new_coordinator(job, epoch, world)
+                if want_coord else ("", 0))
         except Exception as e:  # noqa: BLE001 - reject batch loudly
             # a silent failure here would hang every worker in this
             # batch; closing their connections surfaces a clean
@@ -1378,9 +1908,9 @@ class Tracker:
             "delegates": [min(g) for g in groups],
             "single_host": single_host,
         }
-        self._wal("topo", doc=topo)
+        self._wal("topo", doc=topo, _job=job)
         with self._lock:
-            self._topo = topo
+            job._topo = topo
         for rank in sorted(slot_of.values()):
             conn = conns[rank]
             parent, children = tree_neighbors(rank, world)
@@ -1442,7 +1972,7 @@ class Tracker:
         # teardown-before-ack contract: once EVERY member acked epoch N,
         # no client of an epoch < N exists anywhere -> reap old services
         if all_acked:
-            self._reap_old_services(epoch)
+            self._reap_old_services(job, epoch)
 
 
 def _main(argv: Optional[List[str]] = None) -> int:
@@ -1450,7 +1980,10 @@ def _main(argv: Optional[List[str]] = None) -> int:
     control-plane transition; ``--resume <wal_dir>`` replays it and
     re-adopts a live world after a crash — pin ``--host``/``--port``
     to the dead incarnation's address so the env the workers were
-    launched with stays valid (ISSUE 10)."""
+    launched with stays valid (ISSUE 10). ``--multi-job`` turns the
+    tracker into a long-lived multiplexing service: workers address a
+    job by prefixing their task id (``<job>/<task>``) and launchers
+    park via the ``submit`` command (ISSUE 15)."""
     import argparse
     ap = argparse.ArgumentParser(description=_main.__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
@@ -1461,10 +1994,14 @@ def _main(argv: Optional[List[str]] = None) -> int:
                          "(also RABIT_TRACKER_WAL_DIR)")
     ap.add_argument("--resume", metavar="WAL_DIR", default=None,
                     help="replay WAL_DIR and re-adopt the live world")
+    ap.add_argument("--multi-job", action="store_true",
+                    help="serve many fault-isolated jobs on this one "
+                         "tracker (also RABIT_MULTI_JOB=1)")
     args = ap.parse_args(argv)
     tr = Tracker(args.num_workers, host=args.host, port=args.port,
                  wal_dir=args.resume or args.wal_dir,
-                 resume=args.resume is not None).start()
+                 resume=args.resume is not None,
+                 multi_job=True if args.multi_job else None).start()
     print(f"[tracker] listening on {tr.host}:{tr.port}",
           file=sys.stderr, flush=True)
     try:

@@ -539,9 +539,111 @@ def _smoke() -> None:
     print("wal smoke ok")
 
 
+# ------------------------------------------------------------ inspection
+
+
+def inspect_journal(root: str) -> Dict[str, Any]:
+    """One journal directory's health: per-kind record counts, last
+    seq, lease state, torn-tail status. Never raises on a damaged
+    journal — the whole point is debugging one."""
+    doc: Dict[str, Any] = {"dir": root, "records": 0, "kinds": {},
+                           "last_seq": 0, "lease": None,
+                           "lease_expired": None, "torn_tail_bytes": 0,
+                           "error": None}
+    log = WriteAheadLog(root)
+    try:
+        records, clean_end = log._scan()
+    except WalError as e:
+        doc["error"] = f"{type(e).__name__}: {e}"
+        return doc
+    doc["records"] = len(records)
+    doc["last_seq"] = len(records)
+    kinds: Dict[str, int] = {}
+    for kind, _data in records:
+        kinds[kind] = kinds.get(kind, 0) + 1
+    doc["kinds"] = kinds
+    lease = last_lease(records)
+    if lease is not None:
+        doc["lease"] = lease
+        doc["lease_expired"] = lease_expired(lease)
+    try:
+        doc["torn_tail_bytes"] = max(
+            0, os.path.getsize(log.path) - clean_end)
+    except OSError:
+        pass
+    return doc
+
+
+def inspect_dir(wal_dir: str) -> Dict[str, Any]:
+    """Inspect a tracker WAL directory tree: the root journal plus
+    every per-job namespace underneath it (``<wal_dir>/<job_id>/`` —
+    ISSUE 15) and the standby's replica when present."""
+    out: Dict[str, Any] = {"root": None, "jobs": {}}
+    if os.path.exists(os.path.join(wal_dir, LOG_NAME)):
+        out["root"] = inspect_journal(wal_dir)
+    try:
+        subdirs = sorted(os.listdir(wal_dir))
+    except OSError:
+        subdirs = []
+    for name in subdirs:
+        sub = os.path.join(wal_dir, name)
+        if os.path.isdir(sub) and \
+                os.path.exists(os.path.join(sub, LOG_NAME)):
+            out["jobs"][name] = inspect_journal(sub)
+    return out
+
+
+def _print_inspection(doc: Dict[str, Any]) -> None:
+    def fmt(tag: str, j: Dict[str, Any]) -> None:
+        if j.get("error"):
+            print(f"{tag}: UNREADABLE — {j['error']}")
+            return
+        kinds = ", ".join(f"{k}={n}" for k, n in
+                          sorted(j["kinds"].items())) or "(empty)"
+        torn = (f", torn tail {j['torn_tail_bytes']}B"
+                if j["torn_tail_bytes"] else "")
+        lease = ""
+        if j["lease"] is not None:
+            state = ("EXPIRED" if j["lease_expired"] else "live")
+            lease = (f", lease {state} "
+                     f"(owner {j['lease'].get('owner')})")
+        print(f"{tag}: seq {j['last_seq']}, {kinds}{torn}{lease}")
+
+    if doc["root"] is None:
+        print("(no root journal)")
+    else:
+        fmt("root", doc["root"])
+    for name, j in sorted(doc["jobs"].items()):
+        fmt(f"job {name}" if name != "standby" else "standby replica",
+            j)
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import sys as _sys
+    ap = argparse.ArgumentParser(
+        description="Tracker WAL tools: --smoke (CI tier 0i) or "
+                    "--inspect <dir> (per-job record counts, last "
+                    "seq, lease state, torn-tail status).")
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--inspect", metavar="WAL_DIR", default=None)
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable --inspect output")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        _smoke()
+        return 0
+    if args.inspect:
+        doc = inspect_dir(args.inspect)
+        if args.json:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+        else:
+            _print_inspection(doc)
+        return 0 if not (doc["root"] or {}).get("error") else 1
+    ap.print_help(_sys.stderr)
+    return 2
+
+
 if __name__ == "__main__":
     import sys
-    if "--smoke" in sys.argv:
-        _smoke()
-    else:
-        print(__doc__)
+    sys.exit(_main())

@@ -77,6 +77,13 @@ echo "== tier 0k: failover smoke (replicate -> crash -> promote) =="
 # while the leader's lease was still live (split-brain gate)
 python -m rabit_tpu.tracker.standby --smoke
 
+echo "== tier 0l: multi-job smoke (submit -> two worlds -> admission) =="
+# one tracker, two fault-isolated jobs: both worlds form with
+# independent ranks and epochs, a third job past rabit_max_jobs
+# queues FIFO, a fourth past the queue depth is shed with a backoff
+# hint, and closing a live job admits the queued one
+python -m rabit_tpu.tracker.jobs --smoke
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

@@ -1,0 +1,22 @@
+"""R007 corpus: per-world state left on the Tracker (belongs on
+JobState), plus an unannotated Tracker attribute. Driven directly by
+tests/test_analysis.py through ``_r007_issues`` with the real
+tracker-path ``rel`` (the rule is path-gated to tracker/tracker.py, so
+the framework never fires it on this fixture in place)."""
+
+import threading
+
+
+class Tracker:
+    def __init__(self):
+        self._lock = threading.Lock()  # fleet-global
+        self._jobs = {}                # fleet-global
+        self._ranks = {}               # expect: R007
+        self._admission = []           # expect: R007
+
+    def poke(self):
+        self._epoch = 1                # expect: R007
+
+    def ok(self):
+        # later stores of an annotated attribute need no new marker
+        self._jobs = {}

@@ -98,6 +98,34 @@ def test_c003_corpus_warns_and_noqa():
     assert a.RULES["C003"].tier == "warn"
 
 
+def test_r007_corpus_exact_lines():
+    """R007 is path-gated to tracker/tracker.py, so the fixture is
+    parsed here and driven through _r007_issues with the real rel."""
+    import ast
+    a = _analysis()
+    rr = a.rules_repo
+    with open(os.path.join(CORPUS, "r007_jobstate.py"),
+              encoding="utf-8") as f:
+        src = f.read()
+    issues = rr._r007_issues(rr.R007_FILE, ast.parse(src),
+                             src.splitlines())
+    got = {(line, code) for _rel, line, code, _msg in issues}
+    assert got == _expected_markers("r007_jobstate.py")
+    per_world = [msg for _r, _l, _c, msg in issues if "_ranks" in msg]
+    assert per_world and "JobState" in per_world[0]
+
+
+def test_r007_clean_on_real_tracker():
+    import ast
+    a = _analysis()
+    rr = a.rules_repo
+    path = os.path.join(ROOT, "rabit_tpu", "tracker", "tracker.py")
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    assert rr._r007_issues(rr.R007_FILE, ast.parse(src),
+                           src.splitlines()) == []
+
+
 def test_clean_fixture_is_silent():
     a = _analysis()
     codes = set(a.RULES) - {"R005", "R006"}  # doc rules are repo-wide
@@ -210,7 +238,7 @@ def test_registry_metadata_complete():
     assert set(a.RULES) == {
         "E999", "W291", "W191", "F401",
         "T001", "T002", "T003",
-        "R001", "R002", "R003", "R004", "R005", "R006",
+        "R001", "R002", "R003", "R004", "R005", "R006", "R007",
         "C001", "C002", "C003",
     }
     for code, r in a.RULES.items():

@@ -1,0 +1,434 @@
+"""Multi-job control plane (ISSUE 15): JobState lifecycle, the bounded
+admission queue, submit verdicts, the per-job quarantine boundary,
+single-job byte-identity with ``rabit_multi_job`` unset, resume
+re-adoption of live jobs, and — slow tier — the end-to-end fault
+isolation proof: killing every worker of job A mid-collective leaves a
+concurrent job B's per-round CRC stream bit-identical to a solo
+baseline, with zero B evictions and the tracker never restarting."""
+
+import json
+import os
+import re
+import socket
+import struct
+import sys
+import threading
+import time
+
+import pytest
+
+from rabit_tpu.tracker import jobs as J
+from rabit_tpu.tracker.jobs import (
+    AdmissionQueue, JobState, job_task, split_task)
+from rabit_tpu.tracker.tracker import MAGIC as WIRE_MAGIC, Tracker
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(ROOT, "native", "build", "librabit_tpu_core.so")
+WORKERS = os.path.join(ROOT, "tests", "workers")
+
+
+# --------------------------------------------------------------- helpers
+
+def _send_u32(s, v):
+    s.sendall(struct.pack("<I", v))
+
+
+def _send_str(s, txt):
+    b = txt.encode()
+    _send_u32(s, len(b))
+    s.sendall(b)
+
+
+def _form_job(tr, job, n=2, cmd="start"):
+    """Register one job's whole world over the raw wire; returns the
+    sorted (rank, world, epoch) triples."""
+    conns = [J.wire_register(tr.host, tr.port, job_task(job, str(i)))
+             for i in range(n)]
+    return sorted(J.wire_read_assignment(c) for c in conns)
+
+
+@pytest.fixture
+def multi_env(monkeypatch):
+    monkeypatch.setenv("RABIT_MULTI_JOB", "1")
+
+
+# --------------------------------------------------- JobState lifecycle
+
+def test_jobstate_lifecycle():
+    jb = JobState("a", 2)
+    assert jb.status == "forming" and jb.open
+    jb.mark_live()
+    assert jb.status == "live"
+    jb.mark_failed("all ranks lost")
+    assert jb.status == "failed" and jb.open   # still counted, may re-form
+    jb.mark_live()                             # elastic re-formation
+    assert jb.status == "live"
+    jb.close("complete")
+    assert jb.status == "closed" and not jb.open
+    jb.mark_live()                             # closed is terminal
+    assert jb.status == "closed"
+    doc = jb.doc()
+    assert doc["job"] == "a" and doc["closed_reason"] == "complete"
+
+
+def test_jobstate_all_down():
+    jb = JobState("a", 2)
+    jb._shutdown_ranks.add(0)
+    assert not jb.all_down_locked()
+    jb._shutdown_ranks.add(1)
+    assert jb.all_down_locked()
+    # elastic: only the LIVE membership must drain — evicted ranks
+    # never send shutdown and must not wedge completion
+    ej = JobState("e", 3, elastic=True)
+    ej._member.formed({0, 1, 2})
+    ej._member.evict(2)
+    ej._shutdown_ranks |= {0, 1}
+    assert ej.all_down_locked()
+
+
+def test_split_and_join_task_ids():
+    assert split_task("alpha/7") == ("alpha", "7")
+    assert split_task("3") == (J.DEFAULT_JOB, "3")
+    assert split_task("/x") == (J.DEFAULT_JOB, "/x")  # empty job: literal
+    assert split_task("a/b/c") == ("a", "b/c")
+    assert job_task("alpha", "7") == "alpha/7"
+    assert job_task(J.DEFAULT_JOB, "7") == "7"
+
+
+# --------------------------------------------------- admission queue
+
+def test_admission_queue_fifo_bound_idempotent():
+    q = AdmissionQueue(depth=2)
+    assert q.offer({"job": "a", "nworkers": 2}) == 0
+    assert q.offer({"job": "b", "nworkers": 2}) == 1
+    assert q.offer({"job": "a", "nworkers": 2}) == 0   # idempotent resubmit
+    assert q.queued_total == 2
+    assert q.offer({"job": "c", "nworkers": 2}) == -1  # full: shed
+    assert q.shed_total == 1
+    assert q.peek()["job"] == "a"
+    assert q.pop_front()["job"] == "a"                 # strict FIFO
+    assert q.pop_front()["job"] == "b"
+    assert q.pop_front() is None
+    assert len(q) == 0
+
+
+# --------------------------------------------------- submit verdicts
+
+def test_submit_verdicts(multi_env, monkeypatch):
+    monkeypatch.setenv("RABIT_MAX_JOBS", "1")
+    monkeypatch.setenv("RABIT_ADMISSION_QUEUE", "1")
+    tr = Tracker(2).start()
+    try:
+        v = J.submit(tr.host, tr.port, "a", 2)
+        assert v == {"ok": 1, "job": "a"}
+        assert J.submit(tr.host, tr.port, "a", 2).get("already") == 1
+        v = J.submit(tr.host, tr.port, "b", 1)
+        assert v.get("queued") == 1 and v["position"] == 0
+        assert v["retry_after_ms"] > 0
+        v = J.submit(tr.host, tr.port, "c", 1)
+        assert v.get("shed") == 1 and v["retry_after_ms"] > 0
+        # never-admissible shapes answer an error verdict, not a drop
+        assert "error" in J.submit(tr.host, tr.port, "", 2)
+        assert "error" in J.submit(tr.host, tr.port, "d", 0)
+    finally:
+        tr.stop()
+
+
+def test_submit_disabled_without_knob(monkeypatch):
+    monkeypatch.delenv("RABIT_MULTI_JOB", raising=False)
+    tr = Tracker(2).start()
+    try:
+        v = J.submit(tr.host, tr.port, "a", 2)
+        assert v["ok"] == 0 and "multi-job disabled" in v["error"]
+    finally:
+        tr.stop()
+
+
+def test_max_fleet_ranks_cap(multi_env, monkeypatch):
+    monkeypatch.setenv("RABIT_MAX_FLEET_RANKS", "4")
+    tr = Tracker(2).start()
+    try:
+        assert J.submit(tr.host, tr.port, "a", 3)["ok"] == 1
+        # 3 + 2 > 4: queued, not admitted
+        assert J.submit(tr.host, tr.port, "b", 2).get("queued") == 1
+        # a job bigger than the whole fleet can NEVER be admitted:
+        # error, not an eternal queue entry
+        assert "error" in J.submit(tr.host, tr.port, "c", 5)
+    finally:
+        tr.stop()
+
+
+# --------------------------------------------------- quarantine boundary
+
+def test_quarantine_catches_handler_exception(multi_env):
+    tr = Tracker(2).start()
+    try:
+        assert J.submit(tr.host, tr.port, "q", 2)["ok"] == 1
+        # endpoint with a non-integer port: int() raises inside the
+        # handler -> caught at the job boundary, counted against THIS
+        # job, and the tracker keeps serving
+        c = socket.create_connection((tr.host, tr.port), timeout=10)
+        _send_u32(c, WIRE_MAGIC)
+        _send_str(c, "endpoint")
+        _send_str(c, "q/0")
+        _send_u32(c, 0)
+        _send_str(c, json.dumps({"host": "h", "port": "not-a-port"}))
+        c.close()
+        deadline = time.monotonic() + 10
+        while tr.job("q").quarantined == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert tr.job("q").quarantined == 1
+        # the accept loop survived: a full world still forms
+        assert _form_job(tr, "q", 2) == [(0, 2, 1), (1, 2, 1)]
+        assert tr.job("q").status == "live"
+    finally:
+        tr.stop()
+
+
+# --------------------------------------------------- fault domains
+
+def test_job_failure_is_isolated(multi_env):
+    tr = Tracker(2).start()
+    try:
+        assert J.submit(tr.host, tr.port, "victim", 2,
+                        elastic=True)["ok"] == 1
+        assert J.submit(tr.host, tr.port, "healthy", 2)["ok"] == 1
+        assert _form_job(tr, "victim") == [(0, 2, 1), (1, 2, 1)]
+        assert _form_job(tr, "healthy") == [(0, 2, 1), (1, 2, 1)]
+        victim, healthy = tr.job("victim"), tr.job("healthy")
+        assert victim.status == healthy.status == "live"
+        # every live victim rank dies (watchdog-evidence surrogate):
+        # the job fails INSIDE its own domain
+        assert tr.evict_rank(0, "test: worker died", job=victim)
+        assert victim.status == "live"        # one survivor left
+        assert tr.evict_rank(1, "test: worker died", job=victim)
+        assert victim.status == "failed"
+        # the neighbor never observed any of it
+        assert healthy.status == "live"
+        assert healthy._epoch == 1 and not healthy._shutdown_ranks
+        # and its ranks still shut down cleanly
+        for i in range(2):
+            J.wire_shutdown(tr.host, tr.port, f"healthy/{i}")
+        deadline = time.monotonic() + 10
+        while healthy.status != "closed" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert healthy.status == "closed"
+    finally:
+        tr.stop()
+
+
+# --------------------------------------------------- knob-off identity
+
+def test_multi_job_unset_is_single_job(monkeypatch, tmp_path):
+    """``rabit_multi_job`` unset: task ids are never split (a ``/`` is
+    just spelling), no job ever exists beside the default, the WAL
+    carries no job fields, and the live plane grows no job labels."""
+    monkeypatch.delenv("RABIT_MULTI_JOB", raising=False)
+    monkeypatch.setenv("RABIT_METRICS_PORT", "0")
+    root = str(tmp_path / "wal")
+    tr = Tracker(2, wal_dir=root).start()
+    try:
+        assert not tr.multi_job
+        # slashed task ids land in the ONE default world, unsplit
+        conns = [J.wire_register(tr.host, tr.port, t)
+                 for t in ("alpha/0", "beta/1")]
+        got = sorted(J.wire_read_assignment(c) for c in conns)
+        assert got == [(0, 2, 1), (1, 2, 1)]
+        assert tr.job("alpha") is None and tr.job("beta") is None
+        assert set(tr._ranks) == {"alpha/0", "beta/1"}
+        # no per-job mirror dirs appeared beside the root journal
+        assert not any(os.path.isdir(os.path.join(root, d))
+                       for d in os.listdir(root))
+        # live plane: no job label, no admission families, no per-job
+        # straggler map
+        host, port = tr.live_addr()
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert 'job="' not in text
+        assert "rabit_tracker_jobs" not in text
+        assert "rabit_admission_queue_depth" not in text
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/straggler", timeout=5) as r:
+            strag = json.load(r)
+        assert "jobs" not in strag
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/jobs", timeout=5) as r:
+            jobs_doc = json.load(r)
+        assert jobs_doc["multi_job"] is False
+    finally:
+        tr.stop()
+    # journal: not one record carries a job field
+    from rabit_tpu.tracker.wal import WriteAheadLog
+    w = WriteAheadLog(root)
+    recs = w.open(resume=True)
+    w.close()
+    assert recs, "journal empty"
+    for kind, data in recs:
+        assert "job" not in data, (kind, data)
+        assert kind not in ("job_open", "job_close"), kind
+
+
+# --------------------------------------------------- resume re-adoption
+
+def _resume_tracker(dead, root):
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            return Tracker(dead.nworkers, host=dead.host, port=dead.port,
+                           wal_dir=root, resume=True)
+        except OSError:
+            assert time.monotonic() < deadline, "port never freed"
+            time.sleep(0.05)
+
+
+def test_resume_readopts_live_jobs(multi_env, tmp_path):
+    root = str(tmp_path / "wal")
+    tr = Tracker(2, wal_dir=root).start()
+    try:
+        assert J.submit(tr.host, tr.port, "jobA", 2)["ok"] == 1
+        assert J.submit(tr.host, tr.port, "jobB", 2)["ok"] == 1
+        assert _form_job(tr, "jobA") == [(0, 2, 1), (1, 2, 1)]
+        assert _form_job(tr, "jobB") == [(0, 2, 1), (1, 2, 1)]
+        # advance ONLY jobB's epoch: per-job epochs must resume apart
+        assert _form_job(tr, "jobB", cmd="recover") == [(0, 2, 2),
+                                                        (1, 2, 2)]
+        # job-scoped WAL namespaces exist beside the root journal
+        for jid in ("jobA", "jobB"):
+            assert os.path.isfile(os.path.join(root, jid, "tracker.wal"))
+    finally:
+        tr.stop()
+    tr2 = _resume_tracker(tr, root).start()
+    try:
+        ja, jb = tr2.job("jobA"), tr2.job("jobB")
+        assert ja is not None and jb is not None, "jobs not re-adopted"
+        assert ja._epoch == 1 and jb._epoch == 2
+        assert ja._ranks == {"0": 0, "1": 1}
+        assert jb._ranks == {"0": 0, "1": 1}
+        assert ja.open and jb.open
+    finally:
+        tr2.stop()
+
+
+def test_closed_job_not_readopted_open(multi_env, tmp_path):
+    root = str(tmp_path / "wal")
+    tr = Tracker(2, wal_dir=root).start()
+    try:
+        assert J.submit(tr.host, tr.port, "done", 2)["ok"] == 1
+        assert _form_job(tr, "done") == [(0, 2, 1), (1, 2, 1)]
+        for i in range(2):
+            J.wire_shutdown(tr.host, tr.port, f"done/{i}")
+        deadline = time.monotonic() + 10
+        while tr.job("done").status != "closed" \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert tr.job("done").status == "closed"
+    finally:
+        tr.stop()
+    tr2 = _resume_tracker(tr, root).start()
+    try:
+        done = tr2.job("done")
+        assert done is None or not done.open
+    finally:
+        tr2.stop()
+
+
+# --------------------------------------------------- cluster (slow tier)
+
+def _read_crcs(out_dir, job, rank):
+    path = os.path.join(out_dir, f"r{job}_{rank}.log")
+    with open(path) as f:
+        lines = f.read().splitlines()
+    crcs = []
+    for ln in lines:
+        m = re.match(r"sum round=(\d+) world=(\d+) crc=([0-9a-f]{8})$",
+                     ln)
+        if m:
+            crcs.append((int(m.group(1)), int(m.group(2)), m.group(3)))
+    return lines, crcs
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not os.path.isfile(LIB),
+                    reason="native core not built")
+def test_two_job_fault_isolation(multi_env, tmp_path):
+    """Job A's whole world dies mid-collective; concurrent job B on the
+    SAME tracker finishes with a CRC stream bit-identical to running
+    alone — zero B evictions, one tracker incarnation throughout."""
+    from rabit_tpu.tracker.launch import submit_launch
+
+    rounds = 4
+    worker = os.path.join(WORKERS, "multijob_worker.py")
+
+    def run_job(tr, job, out_dir, die_at=-1, elastic=False):
+        cmd = [sys.executable, worker, f"mj_out={out_dir}",
+               f"mj_rounds={rounds}"]
+        if die_at >= 0:
+            cmd.append(f"mj_die_at={die_at}")
+        return submit_launch(f"{tr.host}:{tr.port}", job, 2, cmd,
+                             max_attempts=1, timeout=120,
+                             elastic=elastic)
+
+    # solo baseline: job B's shape, alone on its own tracker
+    solo_dir = str(tmp_path / "solo")
+    os.makedirs(solo_dir)
+    tr0 = Tracker(2).start()
+    try:
+        assert run_job(tr0, "B", solo_dir) == 0
+    finally:
+        tr0.stop()
+    _, solo0 = _read_crcs(solo_dir, "B", 0)
+    _, solo1 = _read_crcs(solo_dir, "B", 1)
+    assert len(solo0) == len(solo1) == rounds
+
+    # concurrent run: A (dies at round 1, no respawn) + B on ONE tracker
+    both_dir = str(tmp_path / "both")
+    os.makedirs(both_dir)
+    tr = Tracker(2).start()
+    rcs = {}
+    try:
+        threads = [
+            threading.Thread(target=lambda: rcs.__setitem__(
+                "A", run_job(tr, "A", both_dir, die_at=1, elastic=True))),
+            threading.Thread(target=lambda: rcs.__setitem__(
+                "B", run_job(tr, "B", both_dir))),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(180)
+        assert rcs.get("B") == 0, f"job B failed: {rcs}"
+        assert rcs.get("A") == 1, f"job A was expected to die: {rcs}"
+
+        # job B: bit-identical to solo, full world every round
+        _, b0 = _read_crcs(both_dir, "B", 0)
+        _, b1 = _read_crcs(both_dir, "B", 1)
+        assert b0 == solo0 and b1 == solo1, \
+            "job B's CRC stream diverged from the solo baseline"
+        assert all(w == 2 for _r, w, _c in b0 + b1)
+
+        # job A really died mid-collective, in its own domain
+        a_lines, a_crcs = _read_crcs(both_dir, "A", 0)
+        assert any(ln.startswith("dying round=1") for ln in a_lines)
+        assert len(a_crcs) == 1     # only round 0 completed
+
+        # zero B evictions, clean close; the tracker never restarted
+        jb = tr.job("B")
+        deadline = time.monotonic() + 10
+        while jb.status != "closed" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert jb.status == "closed"
+        assert jb._shutdown_ranks == {0, 1}
+        assert tr._thread is not None and tr._thread.is_alive()
+
+        # job A's domain absorbed the loss: evict the dead ranks on
+        # watchdog-style evidence and the job fails ALONE
+        ja = tr.job("A")
+        for rank in range(2):
+            tr.evict_rank(rank, "cluster test: worker died", job=ja)
+        assert ja.status == "failed"
+        assert tr.job("B").status == "closed"
+    finally:
+        tr.stop()

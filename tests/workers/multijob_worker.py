@@ -1,0 +1,63 @@
+"""Multi-job cluster worker (tests/test_multi_job.py, ISSUE 15).
+
+One native-engine rank of ONE job on a shared multi-job tracker. Every
+round is a pure function of (round, world), so the logged CRC stream is
+bit-comparable against a solo-baseline run of the same job shape — the
+fault-isolation proof: a neighbor job dying mid-collective must leave
+this job's stream identical to running alone.
+
+``mj_die_at=K`` makes the rank exit hard (no shutdown, no finalize)
+just before collective round K — the whole-job-crash injection for the
+victim job. Config rides argv ``key=value`` pairs exactly like the
+other cluster workers; ``mj_*`` keys are consumed here, the rest feed
+``rabit.init``.
+"""
+
+import os
+import sys
+import zlib
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as np  # noqa: E402
+import rabit_tpu as rabit  # noqa: E402
+
+TASK = os.environ.get("RABIT_TASK_ID", "?")
+COUNT = 8192
+
+
+def main():
+    cfg = dict(a.split("=", 1) for a in sys.argv[1:] if "=" in a)
+    out_dir = cfg.pop("mj_out")
+    rounds = int(cfg.pop("mj_rounds", "4"))
+    die_at = int(cfg.pop("mj_die_at", "-1"))
+    log_path = os.path.join(out_dir, f"r{TASK.replace('/', '_')}.log")
+
+    def log(msg):
+        with open(log_path, "a") as f:
+            f.write(msg + "\n")
+
+    rabit.init([f"{k}={v}" for k, v in cfg.items()], engine="native")
+    rank, world = rabit.get_rank(), rabit.get_world_size()
+    assert rabit.is_distributed()
+    log(f"formed rank={rank} world={world}")
+
+    for rnd in range(rounds):
+        if rnd == die_at:
+            log(f"dying round={rnd}")
+            os._exit(17)    # crash: no shutdown, no finalize
+        a = np.arange(COUNT, dtype=np.int64) * (rank + 1) + rnd
+        out = rabit.allreduce(a, rabit.SUM)
+        expect = (np.arange(COUNT, dtype=np.int64)
+                  * (world * (world + 1) // 2) + rnd * world)
+        np.testing.assert_array_equal(out, expect)
+        log(f"sum round={rnd} world={world} "
+            f"crc={zlib.crc32(out.tobytes()):08x}")
+
+    log("done")
+    rabit.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -55,17 +55,16 @@ _GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z0-9_,\s|]+)")
 SEED_REGISTRY: Dict[str, dict] = {
     "Tracker": {
         "guarded": {
-            # registration / membership / world state — _lock (== _cv)
-            "_ranks": "_lock", "_pending": "_lock", "_epoch": "_lock",
-            "_shutdown_ranks": "_lock", "_metrics": "_lock",
-            "_endpoints": "_lock", "_endpoint_misses": "_lock",
-            "_topo": "_lock", "_skew": "_lock", "_lease": "_lock",
-            "_services": "_lock", "_last_straggler": "_lock",
-            "_poll_count": "_lock", "_resumed_ranks": "_lock",
+            # per-world registration/membership state moved onto
+            # JobState (ISSUE 15); what stays on the Tracker is the
+            # job table, the admission plane, and fleet-global state
+            "_jobs": "_lock", "_lease": "_lock",
+            "_poll_count": "_lock",
             # replication plane — its own condition (leaf toward WAL)
             "_repl_log": "_repl_cv", "_repl_subs": "_repl_cv",
             "_repl_hb": "_repl_cv", "_repl_hb_n": "_repl_cv",
             "_journaled_lease": "_repl_cv",
+            "_job_wals": "_repl_cv",
         },
         # constructor-only paths: run before the serve thread exists
         "exempt": {"_replay", "_note_resume"},

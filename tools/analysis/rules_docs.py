@@ -193,21 +193,26 @@ def check_knob_docs(contexts):
     return findings
 
 
+def _walk_all(nodes):
+    for n in nodes:
+        yield from ast.walk(n)
+
+
 def _dispatched_commands(contexts) -> List[Tuple[str, int]]:
-    """Commands the tracker's per-connection ``_handle`` dispatches on:
-    ``cmd == "x"`` and ``cmd in ("a", "b")`` comparisons."""
+    """Commands the tracker's per-connection dispatcher routes on:
+    ``cmd == "x"`` and ``cmd in ("a", "b")`` comparisons, inside
+    ``_handle`` or its job-boundary split-out ``_dispatch``
+    (ISSUE 15)."""
     out: List[Tuple[str, int]] = []
     for ctx in contexts:
         if ctx.rel != TRACKER_FILE or ctx.tree is None:
             continue
-        handler = None
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, ast.FunctionDef) and node.name == "_handle":
-                handler = node
-                break
-        if handler is None:
+        handlers = [node for node in ast.walk(ctx.tree)
+                    if isinstance(node, ast.FunctionDef)
+                    and node.name in ("_handle", "_dispatch")]
+        if not handlers:
             return []
-        for node in ast.walk(handler):
+        for node in _walk_all(handlers):
             if not isinstance(node, ast.Compare) or len(node.ops) != 1:
                 continue
             if not (isinstance(node.left, ast.Name)

@@ -48,7 +48,11 @@ R003_STATE = {"_ranks", "_topo", "_skew", "_endpoints", "_epoch",
               # record — a lease mutation that skips the WAL is a
               # leadership claim replication can never ship, i.e. a
               # structural split-brain hole
-              "_lease"}
+              "_lease",
+              # multi-job table (ISSUE 15): job_open/job_close records
+              # rebuild it on --resume — adding or closing a job
+              # without journaling is a world the successor forgets
+              "_jobs"}
 _R003_MEMBER_MUTATORS = {"evict", "park", "formed"}
 _R003_EXEMPT_PREFIXES = ("_replay",)
 
@@ -152,6 +156,18 @@ def _r003_mutations(fn_node):
     return out
 
 
+def _is_property_fn(node):
+    """True for ``@property`` getters and ``@x.setter``-style
+    accessors."""
+    for dec in node.decorator_list:
+        if isinstance(dec, ast.Name) and dec.id == "property":
+            return True
+        if isinstance(dec, ast.Attribute) and \
+                dec.attr in ("setter", "deleter"):
+            return True
+    return False
+
+
 def _r003_issues(rel, tree):
     """Kept callable with (rel, tree) — tests drive it directly."""
     if rel != R003_FILE:
@@ -162,6 +178,10 @@ def _r003_issues(rel, tree):
             continue
         if node.name == "__init__" or \
                 node.name.startswith(_R003_EXEMPT_PREFIXES):
+            continue
+        if _is_property_fn(node):
+            # delegation properties (ISSUE 15): the store is a façade
+            # over per-job state whose real mutators are journaled
             continue
         muts = _r003_mutations(node)
         if muts and not _calls_any(node, {"_wal"}):
@@ -216,3 +236,88 @@ def check_recovery_counters(ctx):
                        f"expected recovery path '{name}' not found "
                        "(update R004_RECOVERY)"))
     return issues
+
+
+# R007: multi-job state discipline (ISSUE 15). Per-world state lives
+# on JobState (tracker/jobs.py); anything left on the Tracker itself
+# is shared by EVERY job, so an unannotated Tracker attribute is a
+# latent cross-job shared-fate bug. Every ``self.X = ...`` in class
+# Tracker must either be a JobState field (error: move it) or carry a
+# ``# fleet-global`` annotation on at least one of its assignment
+# sites (proof a reviewer judged it job-independent).
+R007_FILE = R003_FILE
+R007_WORLD = {"_ranks", "_pending", "_epoch", "_shutdown_ranks",
+              "_metrics", "_endpoints", "_endpoint_misses", "_topo",
+              "_skew", "_skew_election", "_member", "_resumed_ranks",
+              "_last_straggler", "_services", "_coord_addr"}
+R007_MARK = "# fleet-global"
+
+
+def _r007_issues(rel, tree, lines):
+    """Kept callable with (rel, tree, lines) — tests drive it
+    directly against fixture sources."""
+    if rel != R007_FILE or tree is None:
+        return []
+    cls = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Tracker":
+            cls = node
+            break
+    if cls is None:
+        return [(rel, 1, "R007",
+                 "cannot locate class Tracker "
+                 "(update rules_repo R007)")]
+
+    def _marked(node):
+        end = getattr(node, "end_lineno", None) or node.lineno
+        return any(R007_MARK in lines[i - 1]
+                   for i in range(node.lineno,
+                                  min(end, len(lines)) + 1))
+
+    stores = {}
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        else:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id == "self":
+                stores.setdefault(t.attr, []).append(
+                    (node.lineno, _marked(node)))
+    issues = []
+    for attr, sites in sorted(stores.items()):
+        line = min(ln for ln, _m in sites)
+        if attr in R007_WORLD:
+            issues.append((
+                rel, line, "R007",
+                f"'{attr}' is per-world state — it belongs on "
+                "JobState (tracker/jobs.py), not the Tracker: a "
+                "Tracker-level copy is silently shared by every job "
+                "(cross-job shared fate)"))
+        elif not any(m for _ln, m in sites):
+            issues.append((
+                rel, line, "R007",
+                f"Tracker attribute '{attr}' carries no "
+                "'# fleet-global' annotation — move it onto JobState "
+                "or annotate the assignment that proves it is "
+                "job-independent"))
+    return issues
+
+
+@rule("R007", explain="""\
+Cross-job state leakage: the multi-job tracker (ISSUE 15) keeps all
+per-world state on JobState objects (tracker/jobs.py) so one job's
+world can never bleed into a neighbor's. Any attribute assigned on the
+Tracker itself is shared by EVERY job it serves, so each one must
+either be a JobState field (move it) or carry a '# fleet-global'
+comment on an assignment site — an explicit reviewer judgment that the
+value is job-independent (sockets, locks, the WAL, the admission
+plane).""")
+def check_fleet_global_state(ctx):
+    if ctx.tree is None:
+        return []
+    return _r007_issues(ctx.rel, ctx.tree, ctx.lines)

@@ -30,8 +30,11 @@ collective counter total). Against the tracker it additionally GETs
 detector's verdict EXPLICITLY: ``signal=true`` names the laggard,
 while a tie (``signal=false`` with a ``candidate_rank``) is reported
 as ``verdict: tie`` — the candidate is the tie-break's would-be pick,
-never an accusation the detector itself declined to make. Exit 0 when
-the endpoint is healthy, 1 when unreachable or unhealthy.
+never an accusation the detector itself declined to make. A multi-job
+tracker additionally answers ``/jobs``, and the line grows per-job
+health (status / world / epoch / quarantine count) plus the admission
+plane's queue depth and queued/shed totals. Exit 0 when the endpoint
+is healthy, 1 when unreachable or unhealthy.
 """
 
 import glob
@@ -189,6 +192,26 @@ def live_status(target):
                 "busy_skew_s": strag.get("busy_skew_s", 0.0)}
         else:
             doc["straggler"] = {"verdict": "none"}
+    # /jobs is the multi-job tracker's admission/fault-domain route;
+    # single-job trackers and rank endpoints simply lack it (or report
+    # multi_job false), and the field stays absent
+    try:
+        with urllib.request.urlopen(base + "/jobs", timeout=5.0) as r:
+            jobsdoc = json.load(r)
+    except (OSError, ValueError, urllib.error.URLError):
+        jobsdoc = None
+    if isinstance(jobsdoc, dict) and jobsdoc.get("multi_job"):
+        doc["jobs"] = {
+            j["job"]: {"status": j.get("status"),
+                       "world": j.get("world", 0),
+                       "epoch": j.get("epoch", 0),
+                       "quarantined": j.get("quarantined", 0)}
+            for j in jobsdoc.get("jobs", [])
+            if isinstance(j, dict) and j.get("job")}
+        doc["admission"] = {
+            "queue_depth": len(jobsdoc.get("queue", [])),
+            "queued_total": jobsdoc.get("queued_total", 0),
+            "shed_total": jobsdoc.get("shed_total", 0)}
     doc["ok"] = bool(health.get("ok")) and doc["exposition_ok"]
     return doc, doc["ok"]
 

@@ -42,7 +42,10 @@ from analysis.rules_repo import (  # noqa: F401
     R003_FILE,
     R003_STATE,
     R004_RECOVERY,
+    R007_FILE,
+    R007_WORLD,
     _r003_issues,
+    _r007_issues,
     check_raw_sockets,
     check_recovery_counters,
 )
