@@ -813,7 +813,7 @@ NetResult Comm::TryAllreduceRing(char* buf, size_t elem_size, size_t count,
 // resolves whether the in-flight frame was delivered, so a repaired
 // link neither loses nor double-applies a frame. Remaining holes are
 // deliberately bounded, not closed: a bit flip landing in a frame
-// HEADER (16 bytes vs kFrameChunk of payload) can desync the byte
+// HEADER (24 bytes vs kFrameChunk of payload) can desync the byte
 // stream, and a corrupted verdict can strand a retransmission — both
 // exhaust frame_retries_ (or trip a parse check) and surface as
 // kReset, which the robust layer's existing global recovery
@@ -827,16 +827,21 @@ static const uint32_t kVerdictNak = 0;
 // compile-time frame payload cap: both ends derive identical chunking
 // from sizes they already agree on, so no config-skew can desync it
 static const size_t kFrameChunk = 1u << 20;
+// scale-sidecar cap: int8 ships one f32 scale per block, so even a
+// degenerate 2-element block stays under 2x payload; anything larger
+// in the header is corruption, not configuration
+static const size_t kFrameScalesMax = kFrameChunk * 2;
 
-struct FrameHeader {
-  uint32_t magic, seq, len, crc;
-};
+// FrameHeader / FrameWireMeta live in comm.h (the selftest checks the
+// wire layout); only the verdict message is private to this file.
 struct VerdictMsg {
   uint32_t magic, seq, code;
 };
 
 NetResult Comm::FramedStep(int out_li, const char* sbuf, size_t sn,
-                           int in_li, char* rbuf, size_t rn) {
+                           int in_li, char* rbuf, size_t rn,
+                           const FrameWireMeta* wm,
+                           std::vector<char>* rscales) {
   bool send_done = (out_li < 0);
   bool recv_done = (in_li < 0);
   if (send_done && recv_done) return NetResult::kOk;
@@ -864,10 +869,25 @@ NetResult Comm::FramedStep(int out_li, const char* sbuf, size_t sn,
 
   auto enqueue_frame = [&]() {
     LinkIO& o = io_of(out_li);
-    FrameHeader h{kFrameMagic, links_[out_li].send_seq,
-                  static_cast<uint32_t>(sn), Crc32(sbuf, sn)};
+    FrameHeader h;
+    h.magic = kFrameMagic;
+    h.seq = links_[out_li].send_seq;
+    h.len = static_cast<uint32_t>(sn);
+    if (wm != nullptr && wm->codec != kFrameWireNone) {
+      h.wire_codec = wm->codec;
+      h.block_log2 = wm->block_log2;
+      h.scales_len = wm->scales_len;
+    }
+    // one CRC over sidecar then payload: a flipped scale bit rejects
+    // (and retransmits) the whole frame, same as a payload flip
+    uint32_t c = Crc32Begin();
+    if (h.scales_len != 0) c = Crc32Feed(c, wm->scales, h.scales_len);
+    c = Crc32Feed(c, sbuf, sn);
+    h.crc = Crc32End(c);
     const char* hp = reinterpret_cast<const char*>(&h);
     o.out.insert(o.out.end(), hp, hp + sizeof(h));
+    if (h.scales_len != 0)
+      o.out.insert(o.out.end(), wm->scales, wm->scales + h.scales_len);
     o.out.insert(o.out.end(), sbuf, sbuf + sn);
   };
   auto enqueue_verdict = [&](int li, uint32_t seq, uint32_t code) {
@@ -911,13 +931,23 @@ NetResult Comm::FramedStep(int out_li, const char* sbuf, size_t sn,
       return NetResult::kOk;
     }
     if (fh.seq != l.recv_seq || recv_done) return NetResult::kReset;
-    if (Crc32(pay, fh.len) != fh.crc) {
+    // pay holds sidecar + payload contiguously — one CRC covers both,
+    // so a corrupt scale is NAKed and retransmitted like corrupt data
+    if (Crc32(pay, static_cast<size_t>(fh.scales_len) + fh.len) != fh.crc) {
       ++stat_frame_rejects_;
       enqueue_verdict(li, l.recv_seq, kVerdictNak);
       return ++rnaks > frame_retries_ ? NetResult::kReset : NetResult::kOk;
     }
     if (fh.len != rn) return NetResult::kReset;  // plan skew: not healable
-    memcpy(rbuf, pay, rn);
+    if (fh.wire_codec != kFrameWireNone) {
+      // quantized frame at a receiver with no sidecar sink: the two
+      // ends disagree on the wire plan — not healable by retransmit
+      if (rscales == nullptr) return NetResult::kReset;
+      rscales->assign(pay, pay + fh.scales_len);
+    } else if (rscales != nullptr) {
+      rscales->clear();
+    }
+    memcpy(rbuf, pay + fh.scales_len, rn);
     ++l.recv_seq;  // advance BEFORE acking: the resurrection handshake
                    // then proves delivery even when the ack is lost
     recv_done = true;
@@ -993,7 +1023,7 @@ NetResult Comm::FramedStep(int out_li, const char* sbuf, size_t sn,
           case LinkIO::kVerdictSt: need = sizeof(VerdictMsg); dst = o.hdr;
             break;
           case LinkIO::kPayloadSt:
-            need = o.fh.len;
+            need = static_cast<size_t>(o.fh.scales_len) + o.fh.len;
             dst = o.payload.data();
             break;
         }
@@ -1023,8 +1053,17 @@ NetResult Comm::FramedStep(int out_li, const char* sbuf, size_t sn,
           }
           case LinkIO::kFrameSt: {
             memcpy(&o.fh, o.hdr, sizeof(o.fh));
+            // wire-metadata sanity gates BEFORE sizing any buffer: a
+            // corrupted header must not allocate unbounded payload or
+            // smuggle a sidecar into an unquantized frame
             if (o.fh.len > kFrameChunk) return NetResult::kReset;
-            o.payload.resize(o.fh.len);
+            if (o.fh.scales_len > kFrameScalesMax) return NetResult::kReset;
+            if (o.fh.wire_codec > kFrameWireInt8) return NetResult::kReset;
+            if (o.fh.wire_codec != kFrameWireInt8 && o.fh.scales_len != 0)
+              return NetResult::kReset;
+            if (o.fh.wire_codec == kFrameWireNone && o.fh.block_log2 != 0)
+              return NetResult::kReset;
+            o.payload.resize(static_cast<size_t>(o.fh.scales_len) + o.fh.len);
             o.pay_got = 0;
             o.st = LinkIO::kPayloadSt;
             progress = true;

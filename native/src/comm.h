@@ -38,6 +38,45 @@
 
 namespace rt {
 
+// --- framed-plane wire format (rabit_frame_crc=1) ------------------------
+// A frame is [FrameHeader][scales_len sidecar bytes][len payload bytes];
+// crc covers sidecar + payload as one stream. The wire-metadata fields
+// make a frame self-describing for EQuARX-style block quantization
+// (parallel/wire.py): codec names the payload encoding, block_log2 the
+// elements per shipped f32 scale, scales_len the sidecar size in bytes.
+// Unquantized frames (the only kind the native plane currently sends)
+// carry codec=0 / block_log2=0 / scales_len=0 and are parsed by the
+// same state machine — the metadata costs 8 header bytes per frame
+// (vs the 1 MiB payload cap) and buys hop-local retransmission of
+// quantized hops without a second frame round for the sidecar.
+enum FrameWireCodec : uint8_t {
+  kFrameWireNone = 0,   // payload is raw elements
+  kFrameWireBf16 = 1,   // payload is bf16-cast elements, no sidecar
+  kFrameWireInt8 = 2,   // payload is int8 blocks + f32 max-abs scales
+};
+
+struct FrameHeader {
+  uint32_t magic = 0;
+  uint32_t seq = 0;
+  uint32_t len = 0;         // payload bytes (EXCLUDING the sidecar)
+  uint32_t crc = 0;         // over sidecar then payload, one stream
+  uint8_t wire_codec = 0;   // FrameWireCodec
+  uint8_t block_log2 = 0;   // int8 scaling-block elements = 1 << this
+  uint16_t reserved = 0;
+  uint32_t scales_len = 0;  // sidecar bytes (f32 scales; 0 unless int8)
+};
+static_assert(sizeof(FrameHeader) == 24, "frame header is wire format");
+
+// Out-of-band description of a quantized payload a sender attaches to
+// one FramedStep: the sidecar buffer is NOT part of sbuf — the framed
+// plane interleaves it on the wire and checksums both together.
+struct FrameWireMeta {
+  uint8_t codec = kFrameWireNone;
+  uint8_t block_log2 = 0;
+  const char* scales = nullptr;
+  uint32_t scales_len = 0;
+};
+
 class Comm {
  public:
   virtual ~Comm();
@@ -197,15 +236,25 @@ class Comm {
 
   // --- framed data plane (rabit_frame_crc=1) ---------------------------
   // CRC-framed stop-and-wait variants of the streaming collectives: every
-  // payload hop is a [magic|seq|len|crc] frame answered by an ACK/NAK
-  // verdict, so a corrupt frame is rejected and retransmitted hop-local
-  // — never accumulated into the reduction. Off by default; with the
-  // knob unset none of this code runs and the wire is byte-identical.
+  // payload hop is a [magic|seq|len|crc|wire-meta] frame answered by an
+  // ACK/NAK verdict, so a corrupt frame is rejected and retransmitted
+  // hop-local — never accumulated into the reduction. Off by default;
+  // with the knob unset none of this code runs and the wire is
+  // byte-identical.
   // One duplex frame round on up to two links: send a frame out out_li
   // (if >= 0) while receiving one from in_li (if >= 0), then exchange
   // verdicts; retransmits CRC-rejected directions up to frame_retries_.
+  // ``wm`` describes an optionally block-quantized payload (codec +
+  // block + f32 scale sidecar, see FrameWireMeta below): the sidecar
+  // rides INSIDE the frame, covered by the same CRC, so a corrupt
+  // scale retransmits hop-local exactly like corrupt payload bytes.
+  // ``rscales`` receives the inbound sidecar (required non-null to
+  // accept a quantized frame — a receiver not expecting quantization
+  // treats one as plan skew and resets).
   NetResult FramedStep(int out_li, const char* sbuf, size_t sn,
-                       int in_li, char* rbuf, size_t rn);
+                       int in_li, char* rbuf, size_t rn,
+                       const FrameWireMeta* wm = nullptr,
+                       std::vector<char>* rscales = nullptr);
   NetResult FramedSendLink(int li, const char* buf, size_t n);
   NetResult FramedRecvLink(int li, char* buf, size_t n);
   NetResult FramedRingExchange(const char* send_buf, size_t send_n,

@@ -39,13 +39,20 @@ static const uint32_t* Crc32Table() {
 }
 
 uint32_t Crc32(const void* data, size_t n) {
+  return Crc32Feed(Crc32Begin(), data, n) ^ 0xFFFFFFFFu;
+}
+
+uint32_t Crc32Begin() { return 0xFFFFFFFFu; }
+
+uint32_t Crc32Feed(uint32_t state, const void* data, size_t n) {
   const uint32_t* table = Crc32Table();
   const unsigned char* p = static_cast<const unsigned char*>(data);
-  uint32_t c = 0xFFFFFFFFu;
   for (size_t i = 0; i < n; ++i)
-    c = table[(c ^ p[i]) & 0xFFu] ^ (c >> 8);
-  return c ^ 0xFFFFFFFFu;
+    state = table[(state ^ p[i]) & 0xFFu] ^ (state >> 8);
+  return state;
 }
+
+uint32_t Crc32End(uint32_t state) { return state ^ 0xFFFFFFFFu; }
 
 // Interrupt flag is process-global: the watchdog's monitor thread has
 // no engine handle, and the engine's thread-local comm slot would hide

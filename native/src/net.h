@@ -30,6 +30,12 @@ enum class NetResult { kOk, kAgain, kReset, kError, kInterrupt };
 // matches Python's zlib.crc32 so frames checked here can be
 // cross-checked by the test battery without a second implementation.
 uint32_t Crc32(const void* data, size_t n);
+// Incremental form for checksumming discontiguous regions as one
+// stream (frame scale-sidecar + payload): Begin -> Feed... -> End
+// equals one Crc32 over the concatenation.
+uint32_t Crc32Begin();
+uint32_t Crc32Feed(uint32_t state, const void* data, size_t n);
+uint32_t Crc32End(uint32_t state);
 
 // Out-of-band interrupt plane: a watchdog (any thread) raises the
 // flag; collective poll loops observe it and return kInterrupt so the

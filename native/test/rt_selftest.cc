@@ -8,6 +8,7 @@
 #include <vector>
 
 #include "../include/rabit_tpu_c.h"
+#include "../src/comm.h"
 #include "../src/config.h"
 #include "../src/reducer.h"
 #include "../src/stream.h"
@@ -58,6 +59,42 @@ static void TestStream() {
   printf("stream ok\n");
 }
 
+static void TestFrameWire() {
+  // framed-plane wire format: the header layout is a cross-version
+  // contract (sizeof asserted in comm.h) and defaults must describe an
+  // unquantized frame — a pre-quantization peer's zero-filled metadata
+  // parses as codec none / no sidecar
+  rt::FrameHeader h;
+  assert(sizeof(h) == 24);
+  assert(h.wire_codec == rt::kFrameWireNone);
+  assert(h.block_log2 == 0 && h.scales_len == 0);
+  // the frame CRC covers scale sidecar + payload as ONE stream: the
+  // incremental form over the two regions must equal the one-shot CRC
+  // over their concatenation (and both must match RbtFrameCrc32, the
+  // ABI surface Python cross-checks against zlib.crc32)
+  const char scales[] = "\x00\x00\x80\x3f\x00\x00\x00\x40";  // 2 f32
+  const char payload[] = "quantized-blocks";
+  std::vector<char> cat(scales, scales + 8);
+  cat.insert(cat.end(), payload, payload + sizeof(payload));
+  uint32_t inc = rt::Crc32Begin();
+  inc = rt::Crc32Feed(inc, scales, 8);
+  inc = rt::Crc32Feed(inc, payload, sizeof(payload));
+  assert(rt::Crc32End(inc) == rt::Crc32(cat.data(), cat.size()));
+  assert(rt::Crc32(cat.data(), cat.size()) ==
+         RbtFrameCrc32(cat.data(), cat.size()));
+  // a sender's metadata block round-trips through the header fields
+  rt::FrameWireMeta wm;
+  wm.codec = rt::kFrameWireInt8;
+  wm.block_log2 = 10;  // 1024-element scaling blocks
+  wm.scales = scales;
+  wm.scales_len = 8;
+  h.wire_codec = wm.codec;
+  h.block_log2 = wm.block_log2;
+  h.scales_len = wm.scales_len;
+  assert((1u << h.block_log2) == 1024u && h.scales_len == 8);
+  printf("frame wire ok\n");
+}
+
 static void TestCApiWorld1() {
   const char* argv[] = {"rabit_debug=0"};
   assert(RbtInit(1, argv) == 0);
@@ -84,6 +121,7 @@ int main() {
   TestConfig();
   TestReducers();
   TestStream();
+  TestFrameWire();
   TestCApiWorld1();
   printf("rt_selftest: ALL OK\n");
   return 0;

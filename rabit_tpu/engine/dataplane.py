@@ -134,20 +134,23 @@ class XlaDataPlane:
         # native plane keeps its own counters behind RbtRecoveryStats)
         self.retries_total = 0
         # EQuARX-style wire quantization for ring-path float SUMs
-        # (rabit_dataplane_wire = bf16 | int8): compresses only the
-        # ppermute'd ICI bytes; accumulation stays full-precision and
-        # all ranks end bit-identical (the replay-buffer contract).
-        # Validated here even though dispatch reads the env itself: a
-        # typo must not silently run uncompressed while the user
-        # believes the wire is quantized. Whether a requested wire
-        # actually engages is a per-payload-size decision
-        # (rabit_dataplane_wire_mincount / the dispatch table) made in
+        # (rabit_dataplane_wire spec, e.g. bf16 | int8 | int8:bf16@512):
+        # compresses only the ppermute'd ICI bytes; accumulation stays
+        # full-precision and all ranks end bit-identical (the
+        # replay-buffer contract). Validated here even though dispatch
+        # reads the env itself: a typo must not silently run
+        # uncompressed while the user believes the wire is quantized.
+        # Whether a requested wire actually engages is a
+        # per-payload-size decision (rabit_dataplane_wire_mincount /
+        # the dispatch table / adaptive election) made in
         # parallel/dispatch.py.
         wire = os.environ.get("RABIT_DATAPLANE_WIRE", "")
-        if wire and wire not in ("bf16", "int8"):
-            raise ValueError(
-                f"rabit_dataplane_wire must be 'bf16' or 'int8', "
-                f"got {wire!r}")
+        if wire:
+            from ..parallel.wire import canonical_wire as _canonical_wire
+            try:
+                wire = _canonical_wire(wire)
+            except ValueError as e:
+                raise ValueError(f"rabit_dataplane_wire: {e}") from None
         self._wire: Optional[str] = wire or None
         # allreduce algorithm override (rabit_reduce_method = auto |
         # tree | ring | bidir | swing); "auto" consults the measured
@@ -453,9 +456,15 @@ class XlaDataPlane:
                 # label adapted rounds for cross-rank stitching (same
                 # contract as the xla engine span)
                 from ..telemetry import skew as _skewmod
+                from ..parallel import dispatch as _dispatchmod
                 tag = _skewmod.last_applied()
                 if tag:
                     sp.attrs["adapted"] = tag
+                # the wire OUTCOME next to the request above: what
+                # dispatch actually resolved for this payload (gated,
+                # adapted, or forced) — trace_report can then show
+                # request vs outcome per round
+                sp.attrs["wire_applied"] = _dispatchmod.last_wire() or "off"
             res = np.asarray(out.addressable_data(0)).reshape(-1)
         if res.dtype != buf.dtype:
             raise TypeError(

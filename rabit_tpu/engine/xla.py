@@ -110,10 +110,13 @@ class XlaEngine(Engine):
                 f"rabit_reduce_method must be one of "
                 f"{('auto',) + _dispatch.METHODS}, got {self._method!r}")
         wire = cfg.get("rabit_dataplane_wire", "") or None
-        if wire is not None and wire not in ("bf16", "int8"):
-            raise ValueError(
-                f"rabit_dataplane_wire must be 'bf16' or 'int8', "
-                f"got {wire!r}")
+        if wire is not None:
+            from ..parallel import wire as _wirespec
+            try:
+                wire = _wirespec.canonical_wire(wire)
+            except ValueError as e:
+                raise ValueError(
+                    f"rabit_dataplane_wire: {e}") from None
         self._wire = wire
         self._wire_mincount = cfg.get_size(
             "rabit_dataplane_wire_mincount",

@@ -161,50 +161,61 @@ def _group_ring_perm(groups, reverse: bool = False):
     return perm
 
 
-# Wire-quantization modes for the ring collectives (EQuARX-style: the
+# Wire-quantization for the ring collectives (EQuARX-style: the
 # accumulator stays full-precision on-device; only the ppermute'd bytes
 # are compressed — arXiv:2506.17615 does this inside XLA for TPU
-# allreduce). "bf16" halves ICI bytes; "int8" block-scales to ~1/4.
-_INT8_BLOCK = 256
+# allreduce). The codec and the "<rs>[:<ag>][@<block>]" spec grammar
+# live in parallel/wire.py; "bf16" halves ICI bytes, "int8"
+# block-scales to ~1/4 with an f32 max-abs scale per block.
+from .wire import (WIRE_BLOCK_DEFAULT, wire_block,  # noqa: F401 (re-export)
+                   parse_wire as _parse_wire,
+                   format_wire as _format_wire,
+                   canonical_wire as _canonical_wire)
+from .wire import encode as _codec_encode, decode as _codec_decode
+
+# Back-compat alias: the pre-spec codec hard-wired one block size; the
+# live value is now the spec's ``@block`` (default WIRE_BLOCK_DEFAULT,
+# env rabit_wire_block via canonical_wire).
+_INT8_BLOCK = WIRE_BLOCK_DEFAULT
 
 
 def _normalize_wire(wire, op: int, dtype, chunk_len=None):
     """One policy for wire eligibility, used by every ring entry point:
-    quantized wire applies only to float SUM payloads; int8 needs the
-    per-rank chunk to tile into blocks (else degrade to bf16).
-    ``chunk_len=None`` skips the block check — for callers that pad the
-    chunk up to a block multiple themselves (ring_allreduce)."""
+    quantized wire applies only to float SUM payloads; int8 phases need
+    the per-rank chunk to tile into scaling blocks (else degrade that
+    phase to bf16). ``chunk_len=None`` skips the block check — for
+    callers that pad the chunk up to a block multiple themselves
+    (ring_allreduce). Returns the canonical spec string or None."""
     if wire is None:
         return None
-    if wire not in ("bf16", "int8"):
-        raise ValueError(f"wire must be 'bf16' or 'int8', got {wire!r}")
+    rs, ag, block = _parse_wire(wire)  # raises on malformed specs
     if op != SUM or not jnp.issubdtype(dtype, jnp.floating):
         return None
-    if (wire == "int8" and chunk_len is not None
-            and chunk_len % _INT8_BLOCK != 0):
-        return "bf16"
-    return wire
+    if chunk_len is not None and chunk_len % block != 0:
+        rs = "bf16" if rs == "int8" else rs
+        ag = "bf16" if ag == "int8" else ag
+    return _format_wire(rs, ag, block)
+
+
+def _wire_pad_mult(wire, size: int) -> int:
+    """Chunk-alignment multiple for pad-and-slice entry points: any
+    int8 phase needs the per-rank chunk to tile into scaling blocks."""
+    if not wire:
+        return size
+    rs, ag, block = _parse_wire(wire)
+    return size * block if "int8" in (rs, ag) else size
 
 
 def _wire_encode(x, wire: str):
-    if wire == "bf16":
-        return (x.astype(jnp.bfloat16),)
-    # int8: per-block symmetric scale, values in [-127, 127]. The scale
-    # is clamped BEFORE both the division and the shipped value so
-    # encode and decode agree (an unclamped shipped scale would decode
-    # denormal-scale blocks up to 127x too small).
-    blocks = x.reshape(-1, _INT8_BLOCK)
-    scale = jnp.maximum(
-        jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0, 1e-30)
-    q = jnp.round(blocks / scale).astype(jnp.int8)
-    return q, scale.astype(jnp.float32)
+    """Whole-payload encode under ``wire``'s RS codec (back-compat
+    shim for tools; schedule code uses the per-phase codec directly)."""
+    rs, _, block = _parse_wire(wire)
+    return _codec_encode(x, rs, block)
 
 
 def _wire_decode(enc, wire: str, shape):
-    if wire == "bf16":
-        return enc[0].astype(jnp.float32)
-    q, scale = enc
-    return (q.astype(jnp.float32) * scale).reshape(shape)
+    rs, _, block = _parse_wire(wire)
+    return _codec_decode(enc, rs, shape)
 
 
 def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
@@ -244,9 +255,16 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
     if size == 1:
         return x
     wire = _normalize_wire(wire, op, x.dtype, x.shape[0] // size)
+    rs_codec, _, blk = _parse_wire(wire) if wire else (None, None, 0)
     combine = jax_reduce_fn(op)
     idx = pos
-    chunks = x.reshape(size, -1)
+    # EQuARX hop contract: with a quantized wire, every received
+    # contribution decodes to f32 and FOLDS in f32 — quantization error
+    # enters once per hop at the wire, never compounds through a
+    # low-precision accumulator. Cast back to the input dtype only at
+    # the end (identity for f32 payloads).
+    acc_dtype = jnp.float32 if rs_codec else x.dtype
+    chunks = x.reshape(size, -1).astype(acc_dtype)
     # Schedule: at step s, send chunk (idx-s-1) mod p (accumulated so
     # far), receive into chunk (idx-s-2) mod p; after p-1 steps rank i
     # owns chunk i. (Offset chosen so ownership lands on chunk==rank,
@@ -262,16 +280,17 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, op: int = SUM,
             send_i = (idx - step - 1) % size
             recv_i = (idx - step - 2) % size
         send = lax.dynamic_index_in_dim(chunks, send_i, 0, keepdims=False)
-        if wire is None:
+        if rs_codec is None:
             got = lax.ppermute(send, axis_name, perm)
         else:
-            enc = _wire_encode(send, wire)
+            enc = _codec_encode(send, rs_codec, blk)
             enc = tuple(lax.ppermute(e, axis_name, perm) for e in enc)
-            got = _wire_decode(enc, wire, send.shape).astype(send.dtype)
+            got = _codec_decode(enc, rs_codec, send.shape)
         cur = lax.dynamic_index_in_dim(chunks, recv_i, 0, keepdims=False)
         chunks = lax.dynamic_update_index_in_dim(
             chunks, combine(cur, got), recv_i, 0)
-    return lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+    mine = lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+    return mine.astype(x.dtype)
 
 
 def ring_all_gather(x: jax.Array, axis_name: str,
@@ -307,9 +326,10 @@ def ring_all_gather(x: jax.Array, axis_name: str,
     if size == 1:
         return x
     wire = _normalize_wire(wire, SUM, x.dtype, x.shape[0])
-    if wire is not None:
-        enc = _wire_encode(x, wire)
-        x = _wire_decode(enc, wire, x.shape).astype(x.dtype)
+    _, ag_codec, blk = _parse_wire(wire) if wire else (None, None, 0)
+    if ag_codec is not None:
+        enc = _codec_encode(x, ag_codec, blk)
+        x = _codec_decode(enc, ag_codec, x.shape).astype(x.dtype)
     out = jnp.zeros((size,) + x.shape, x.dtype)
     out = lax.dynamic_update_index_in_dim(out, x, idx, 0)
     for step in range(size - 1):
@@ -319,7 +339,7 @@ def ring_all_gather(x: jax.Array, axis_name: str,
         else:
             send_i = (idx - step) % size
             recv_i = (idx - step - 1) % size
-        if wire is None:
+        if ag_codec is None:
             send = lax.dynamic_index_in_dim(out, send_i, 0,
                                             keepdims=False)
             got = lax.ppermute(send, axis_name, perm)
@@ -328,7 +348,7 @@ def ring_all_gather(x: jax.Array, axis_name: str,
             # step s-1 (own chunk at s=0) in either direction: forward
             # its encoding verbatim
             enc = tuple(lax.ppermute(e, axis_name, perm) for e in enc)
-            got = _wire_decode(enc, wire, x.shape).astype(x.dtype)
+            got = _codec_decode(enc, ag_codec, x.shape).astype(x.dtype)
         out = lax.dynamic_update_index_in_dim(out, got, recv_i, 0)
     return out.reshape((size * x.shape[0],) + x.shape[1:])
 
@@ -369,11 +389,10 @@ def ring_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     if size == 1:
         return x
     wire = _normalize_wire(wire, op, x.dtype)  # eligibility; pad below
-    # int8 wants the per-rank chunk to tile into blocks; zero-padding is
-    # the SUM identity and the tail is sliced off, so pad up rather than
-    # silently degrading real-world sizes to bf16
-    mult = size * _INT8_BLOCK if wire == "int8" else size
-    xp, n = _pad_to_multiple(x, mult)
+    # int8 wants the per-rank chunk to tile into scaling blocks;
+    # zero-padding is the SUM identity and the tail is sliced off, so
+    # pad up rather than silently degrading real-world sizes to bf16
+    xp, n = _pad_to_multiple(x, _wire_pad_mult(wire, size))
     mine = ring_reduce_scatter(xp, axis_name, op, wire=wire,
                                reverse=reverse, groups=groups)
     full = ring_all_gather(mine, axis_name, wire=wire, reverse=reverse,
@@ -494,8 +513,9 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     if size & (size - 1) or x.shape[0] == 0:
         return ring_allreduce(x, axis_name, op, wire=wire, groups=groups)
     wire = _normalize_wire(wire, op, x.dtype)  # eligibility; pad below
-    mult = size * _INT8_BLOCK if wire == "int8" else size
-    xp, n = _pad_to_multiple(x, mult)
+    rs_codec, ag_codec, blk = (_parse_wire(wire) if wire
+                               else (None, None, 0))
+    xp, n = _pad_to_multiple(x, _wire_pad_mult(wire, size))
     peers, send_idx, recv_idx = _swing_tables(size)
     k = len(peers)
     combine = jax_reduce_fn(op)
@@ -511,7 +531,10 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
         return [(grp[i], grp[peers[s][i]]) for grp in groups
                 for i in range(size)]
 
-    chunks = xp.reshape(size, -1)
+    # EQuARX hop contract (see ring_reduce_scatter): quantized-wire
+    # contributions decode to f32 and fold in f32; cast back at the end
+    acc_dtype = jnp.float32 if rs_codec else xp.dtype
+    chunks = xp.reshape(size, -1).astype(acc_dtype)
     m = chunks.shape[1]
 
     # Reduce-scatter: at step s exchange with peers[s], shipping the
@@ -524,15 +547,16 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
         send_rows = jnp.asarray(send_idx[s])[idx]
         recv_rows = jnp.asarray(recv_idx[s])[idx]
         send = jnp.take(chunks, send_rows, axis=0)
-        if wire is None:
+        if rs_codec is None:
             got = lax.ppermute(send, axis_name, perm)
         else:
-            enc = _wire_encode(send, wire)
+            enc = _codec_encode(send, rs_codec, blk)
             enc = tuple(lax.ppermute(e, axis_name, perm) for e in enc)
-            got = _wire_decode(enc, wire, send.shape).astype(send.dtype)
+            got = _codec_decode(enc, rs_codec, send.shape)
         cur = jnp.take(chunks, recv_rows, axis=0)
         chunks = chunks.at[recv_rows].set(combine(cur, got))
-    mine = lax.dynamic_index_in_dim(chunks, idx, 0, keepdims=False)
+    mine = lax.dynamic_index_in_dim(chunks, idx, 0,
+                                    keepdims=False).astype(xp.dtype)
 
     # All-gather: the same schedule backward — at step s each rank has
     # its responsibility set resp[s] complete and ships it, receiving
@@ -540,7 +564,7 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     # and the encoded bytes travel verbatim thereafter (see
     # ring_all_gather on why re-encoding per hop breaks the
     # bit-identical-ranks replay contract).
-    if wire is None:
+    if ag_codec is None:
         out = jnp.zeros((size, m), mine.dtype)
         out = lax.dynamic_update_index_in_dim(out, mine, idx, 0)
         for s in range(k - 1, -1, -1):
@@ -551,7 +575,7 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
             got = lax.ppermute(send, axis_name, perm)
             out = out.at[recv_rows].set(got)
     else:
-        enc0 = _wire_encode(mine, wire)
+        enc0 = _codec_encode(mine, ag_codec, blk)
         store = tuple(
             lax.dynamic_update_index_in_dim(
                 jnp.zeros((size,) + e.shape, e.dtype), e, idx, 0)
@@ -565,12 +589,8 @@ def swing_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
                              axis_name, perm) for e in store)
             store = tuple(e.at[recv_rows].set(g)
                           for e, g in zip(store, got))
-        if wire == "bf16":
-            out = store[0].astype(jnp.float32)
-        else:
-            q, scale = store
-            out = q.astype(jnp.float32) * scale
-        out = out.reshape(size, m).astype(mine.dtype)
+        out = _codec_decode(store, ag_codec,
+                            (size, m)).astype(mine.dtype)
     return out.reshape(size * m)[:n]
 
 
@@ -658,8 +678,7 @@ def hier_allreduce(x: jax.Array, axis_name: str, op: int = SUM,
     # pad so the intra shard (n/g) splits evenly into inter chunks
     # (n/p); the int8 block constraint lands on the inter phase's
     # per-rank chunk
-    mult = p * _INT8_BLOCK if wire == "int8" else p
-    xp, n = _pad_to_multiple(x, mult)
+    xp, n = _pad_to_multiple(x, _wire_pad_mult(wire, p))
     with telemetry.trace_annotation("rabit_hier_reduce_scatter"):
         mine = _intra_reduce_scatter(xp, axis_name, op, groups)
     with telemetry.trace_annotation("rabit_hier_inter"):
@@ -853,7 +872,9 @@ def _per_shard_allreduce(flat, axis: str, op: int, method: str,
     # named_scope (metadata-only, zero jaxpr equations either way) makes
     # the chosen schedule attributable in XLA profiles when telemetry is
     # on; nullcontext when off
-    label = f"rabit_allreduce_{method}" + (f"_{wire}" if wire else "")
+    # spec separators (:@) are not valid named_scope characters
+    wtag = wire.replace(":", "_").replace("@", "_") if wire else ""
+    label = f"rabit_allreduce_{method}" + (f"_{wtag}" if wtag else "")
     with telemetry.trace_annotation(label):
         if method == "tree":
             return tree_allreduce(flat, axis, op)
@@ -1089,7 +1110,10 @@ def device_reduce_scatter(xs: jax.Array, mesh: Mesh, op: int = SUM,
     n must divide by p: a composable primitive must not pad silently,
     the caller owns the chunk layout (:func:`device_allreduce` is the
     pad-and-slice convenience). ``wire`` compresses the shipped bytes
-    as in :func:`ring_reduce_scatter` (float SUM only)."""
+    as in :func:`ring_reduce_scatter` (float SUM only; the spec's RS
+    phase codec applies); ``wire="auto"`` consults dispatch — the
+    env-requested wire engages only where gating/adaptive election says
+    it pays, exactly as in :func:`device_allreduce`."""
     if axis is None:
         axis = mesh.axis_names[0]
     p = mesh.shape[axis]
@@ -1099,8 +1123,10 @@ def device_reduce_scatter(xs: jax.Array, mesh: Mesh, op: int = SUM,
             f"reduce_scatter payload of {n} elements must divide by the "
             f"axis size {p} (rank i owns chunk i of length n/p); pad the "
             "input or use device_allreduce")
-    wire = None if wire in (None, "none", "auto") else wire
-    wire = _normalize_wire(wire, op, xs.dtype, n // p)
+    if wire == "auto":
+        _, wire = _dispatch_resolve(n, xs.dtype, op, p, method="ring",
+                                    wire="auto")
+    wire = _normalize_wire(_canonical_wire(wire), op, xs.dtype, n // p)
     order, adapted = _rotation_for(mesh, axis, p)
     cost = _profile.record_cost("reduce_scatter", "ring", wire, n,
                                 xs.dtype.itemsize, p, phase="rs")
@@ -1122,20 +1148,23 @@ def device_reduce_scatter(xs: jax.Array, mesh: Mesh, op: int = SUM,
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis", "order"))
-def _allgather_global(xs, mesh: Mesh, axis: str, order=None):
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "wire",
+                                             "order"))
+def _allgather_global(xs, mesh: Mesh, axis: str, wire: str | None = None,
+                      order=None):
     def per_shard(x):
         flat = x.reshape(-1)  # drop the per-device leading 1
         with telemetry.trace_annotation("rabit_allgather"):
             if order is None:
-                return ring_all_gather(flat, axis)
+                return ring_all_gather(flat, axis, wire=wire)
             # laggard-last rotation: gather around the reordered ring
             # (the laggard's chunk enters last), then restore the
             # rank-order concatenation the contract promises — grouped
             # AG concatenates in GROUP order, so the inverse
             # permutation puts chunk of rank order[j] back at slot
             # order[j].
-            gathered = ring_all_gather(flat, axis, groups=(order,))
+            gathered = ring_all_gather(flat, axis, wire=wire,
+                                       groups=(order,))
             chunks = gathered.reshape(len(order), -1)
             inv = [0] * len(order)
             for j, r in enumerate(order):
@@ -1147,20 +1176,30 @@ def _allgather_global(xs, mesh: Mesh, axis: str, order=None):
 
 
 def device_allgather(xs: jax.Array, mesh: Mesh,
-                     axis: Optional[str] = None) -> jax.Array:
+                     axis: Optional[str] = None,
+                     wire: Optional[str] = None) -> jax.Array:
     """All-gather across a mesh axis, as a first-class collective: rank
     i contributes its slice ``xs[i]`` (m elements, flattened) and every
     rank ends with the length p*m rank-order concatenation, replicated
     (TryAllgatherRing, allreduce_base.cc:751-815). The inverse of
     :func:`device_reduce_scatter`'s ownership layout; hierarchical
-    allreduce is literally RS + inter-host reduction + this."""
+    allreduce is literally RS + inter-host reduction + this.
+
+    ``wire`` compresses the forwarded bytes as in
+    :func:`ring_all_gather` (the spec's AG phase codec; float payloads,
+    lossy, all ranks still bit-identical); ``wire="auto"`` consults
+    dispatch's gate/adaptive election like the other entry points."""
     if axis is None:
         axis = mesh.axis_names[0]
     p = mesh.shape[axis]
     m = int(np.prod(xs.shape[1:]))
     n = p * m
+    if wire == "auto":
+        _, wire = _dispatch_resolve(n, xs.dtype, SUM, p, method="ring",
+                                    wire="auto")
+    wire = _normalize_wire(_canonical_wire(wire), SUM, xs.dtype, m)
     order, adapted = _rotation_for(mesh, axis, p)
-    cost = _profile.record_cost("allgather", "ring", None, n,
+    cost = _profile.record_cost("allgather", "ring", wire, n,
                                 xs.dtype.itemsize, p, phase="ag")
     extra = ({"cost_flops": cost["flops"],
               "cost_wire_bytes": cost["wire_bytes"],
@@ -1168,11 +1207,11 @@ def device_allgather(xs: jax.Array, mesh: Mesh,
     if adapted:
         extra["adapted"] = adapted
     sp = telemetry.span("allgather", nbytes=n * xs.dtype.itemsize,
-                        method="ring", **extra)
+                        method="ring", wire=wire, **extra)
     with sp:
         t0 = time.perf_counter()
         with _profile.jit_probe("allgather", _allgather_global):
-            out = _allgather_global(xs, mesh, axis, order)
+            out = _allgather_global(xs, mesh, axis, wire, order)
         if sp.live:
             out.block_until_ready()
             _stamp_exposed(sp, t0)
@@ -1264,9 +1303,11 @@ def device_hier_allreduce(xs: jax.Array, mesh: Mesh, op: int = SUM,
     shape = xs.shape[1:]
     n = int(np.prod(shape))
     itemsize = xs.dtype.itemsize
-    wire = None if wire in (None, "none", "auto") else wire
-    wire = _normalize_wire(wire, op, xs.dtype)
-    mult = p * _INT8_BLOCK if wire == "int8" else p
+    if wire == "auto":
+        _, wire = _dispatch_resolve(n // g, xs.dtype, op, hosts,
+                                    method="ring", wire="auto")
+    wire = _normalize_wire(_canonical_wire(wire), op, xs.dtype)
+    mult = _wire_pad_mult(wire, p)
     n_pad = n + (-n) % mult
     rnd = telemetry.collective_round("hier_allreduce")
     opname = OP_NAMES.get(op, str(op))
@@ -1768,8 +1809,11 @@ def grad_bucket_allreduce_async(xs: jax.Array, mesh: Mesh, dp_axis: str,
     n = int(xs.shape[-1])
     if _skew.adapt_enabled():
         _skew_sync_point(mesh, dp_axis)
-    wire = None if wire in (None, "none", "auto") else wire
-    wire = _normalize_wire(wire, op, xs.dtype)
+    if wire == "auto":
+        _, wire = _dispatch_resolve(n, xs.dtype, op,
+                                    mesh.shape[dp_axis],
+                                    method=method, wire="auto")
+    wire = _normalize_wire(_canonical_wire(wire), op, xs.dtype)
     cost = _profile.record_cost("bucket_allreduce", method, wire, n,
                                 xs.dtype.itemsize, mesh.shape[dp_axis])
     extra = ({"cost_flops": cost["flops"],
@@ -1924,9 +1968,11 @@ def device_hier_allreduce_async(xs: jax.Array, mesh: Mesh, op: int = SUM,
     shape = xs.shape[1:]
     n = int(np.prod(shape))
     itemsize = xs.dtype.itemsize
-    wire = None if wire in (None, "none", "auto") else wire
-    wire = _normalize_wire(wire, op, xs.dtype)
-    mult = p * _INT8_BLOCK if wire == "int8" else p
+    if wire == "auto":
+        _, wire = _dispatch_resolve(n // g, xs.dtype, op, hosts,
+                                    method="ring", wire="auto")
+    wire = _normalize_wire(_canonical_wire(wire), op, xs.dtype)
+    mult = _wire_pad_mult(wire, p)
     n_pad = n + (-n) % mult
     rnd = telemetry.collective_round("hier_allreduce")
     opname = OP_NAMES.get(op, str(op))

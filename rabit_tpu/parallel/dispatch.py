@@ -23,9 +23,11 @@ from __future__ import annotations
 import glob
 import json
 import os
+import re
 from typing import Optional, Tuple
 
 from ..utils.config import parse_size
+from . import wire as _wirespec
 
 # Fallback crossover: ring pays off above 32K elements (reference
 # allreduce_base.cc:35, doc/parameters.md).
@@ -44,15 +46,108 @@ METHODS = ("tree", "ring", "bidir", "swing", "hier")
 EXPLICIT_METHODS = METHODS + ("preagg",)
 
 SCHEMA_PREFIX = "rabit_tpu.collective_sweep/"
-# v2 adds the skew/lag columns (tools/collective_sweep.py --lag-rank);
-# v1 artifacts are committed history and must keep loading.
-SCHEMA = SCHEMA_PREFIX + "v2"
-ACCEPTED_SCHEMAS = (SCHEMA, SCHEMA_PREFIX + "v1")
+# v3 adds block-quantized wire-spec columns ("int8:bf16", "@block") and
+# the per-row wire_block field; v2 added the skew/lag columns
+# (tools/collective_sweep.py --lag-rank); v1/v2 artifacts are committed
+# history and must keep loading.
+SCHEMA = SCHEMA_PREFIX + "v3"
+ACCEPTED_SCHEMAS = (SCHEMA, SCHEMA_PREFIX + "v2", SCHEMA_PREFIX + "v1")
 
 _TABLE_ENV = "RABIT_DISPATCH_TABLE"
 _WIRE_ENV = "RABIT_DATAPLANE_WIRE"
 _WIRE_MINCOUNT_ENV = "RABIT_DATAPLANE_WIRE_MINCOUNT"
+_WIRE_ADAPT_ENV = "RABIT_WIRE_ADAPTIVE"
 _METHOD_ENV = "RABIT_REDUCE_METHOD"
+
+# Table wire columns may hold any canonical wire spec
+# ("<rs>[:<ag>][@<block>]", parallel/wire.py grammar).
+_WIRE_SPEC_RE = re.compile(
+    r"^(bf16|int8|none)(:(bf16|int8|none))?(@[1-9][0-9]*)?$")
+
+# Adaptive-election cost model (rabit_wire_adaptive): predicted wire
+# seconds saved must beat the quantize/dequantize cost, modelled as a
+# fixed per-collective overhead (the scale-computation dispatches) plus
+# a codec throughput term. The constants are deliberately conservative
+# — on-device block quantization streams at memcpy-like rates.
+ADAPT_CODEC_GBPS = 2.0
+ADAPT_OVERHEAD_S = 100e-6
+_ADAPT_MIN_SAMPLES = 4
+_ADAPT_RING_METHODS = ("ring", "bidir", "swing", "hier")
+
+
+def wire_adaptive() -> bool:
+    """Adaptive wire election on/off (``rabit_wire_adaptive``): learn
+    the live link bandwidth from telemetry's per-op counters and engage
+    the env-requested wire only where predicted savings beat the codec
+    cost, instead of the static table/mincount gate."""
+    return os.environ.get(_WIRE_ADAPT_ENV, "").lower() in (
+        "1", "true", "yes", "on")
+
+
+def _measured_bandwidth() -> Optional[float]:
+    """Live bytes/second of the UNQUANTIZED ring-family dataplane,
+    learned from telemetry's allreduce counters (recorder.py keys rows
+    by (name, op, method, wire, bucket)). None until enough samples
+    have durations — dispatch must fall back to the static gate, never
+    guess from thin data."""
+    from .. import telemetry
+    if not telemetry.enabled():
+        return None
+    total_b, total_s, count = 0, 0.0, 0
+    for row in telemetry.counter_rows("allreduce"):
+        if row["wire"] or row["method"] not in _ADAPT_RING_METHODS:
+            continue
+        total_b += row["bytes"]
+        total_s += row["total_s"]
+        count += row["count"]
+    if count < _ADAPT_MIN_SAMPLES or total_s <= 0 or total_b <= 0:
+        return None
+    return total_b / total_s
+
+
+def _adaptive_elect(n: int, itemsize: int,
+                    spec: str) -> Optional[bool]:
+    """Should the requested wire ``spec`` engage for an ``n``-element
+    payload? True/False when telemetry supports a decision, None when
+    it can't (no data, disabled, or a multi-controller world — a
+    per-process election is a divergent static jit arg, so agreement
+    there stays with the static gate until it rides the skew digest
+    plane)."""
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return None
+    except Exception:  # pragma: no cover - jax always importable here
+        return None
+    bw = _measured_bandwidth()
+    if bw is None:
+        return None
+    nbytes = n * itemsize
+    wire_b = _wirespec.wire_itemsize(spec, itemsize)
+    saved_s = nbytes * (1.0 - wire_b / itemsize) / bw
+    codec_s = ADAPT_OVERHEAD_S + nbytes / (ADAPT_CODEC_GBPS * 1e9)
+    return saved_s > codec_s
+
+
+# Last wire actually applied by resolve() on this thread of control —
+# the dataplane stamps it as the span's ``wire_applied`` so traces show
+# request vs outcome (mirrors telemetry.skew's note_applied pattern).
+_last_wire: Optional[str] = None
+_last_wire_provenance: str = ""
+
+
+def note_wire(wire: Optional[str], provenance: str = "") -> None:
+    global _last_wire, _last_wire_provenance
+    _last_wire = wire
+    _last_wire_provenance = provenance
+
+
+def last_wire() -> Optional[str]:
+    return _last_wire
+
+
+def last_wire_provenance() -> str:
+    return _last_wire_provenance
 
 
 def wire_mincount() -> int:
@@ -92,7 +187,9 @@ def _valid_rows(rows) -> bool:
             return False
         if not (r.get("max_n") is None or isinstance(r["max_n"], int)):
             return False
-        if r.get("wire") not in (None, "bf16", "int8"):
+        w = r.get("wire")
+        if w is not None and (not isinstance(w, str)
+                              or not _WIRE_SPEC_RE.match(w)):
             return False
         # "flat": the schedule a hier row degrades to on worlds without
         # a usable host grouping (optional; hier rows only)
@@ -197,16 +294,26 @@ def resolve(n: int, dtype, op: int, axis_size: int,
     counter; the concrete re-root / rotation / pre-aggregation plan is
     applied by ``device_allreduce`` (``telemetry/skew.py``).
 
-    ``wire="auto"``: engages the ``RABIT_DATAPLANE_WIRE`` env wire (the
-    ``rabit_dataplane_wire`` config export) only where measurement says
-    it pays — the table bucket's wire field, else ``n >=
-    wire_mincount()``. An EXPLICITLY configured mincount (the env var is
-    set) beats the table's wire column: a user who pins the gate — e.g.
-    ``rabit_dataplane_wire_mincount=0`` to force quantization at demo
-    sizes — must win over recorded policy, the same precedence rule as
-    the per-call override. No env wire (or a tree method) → None.
-    Explicit ``wire="bf16"/"int8"`` is passed through untouched
-    (per-call override); ``wire="none"``/None force it off.
+    ``wire="auto"``: engages the env-requested wire — the
+    ``RABIT_DATAPLANE_WIRE`` base codec composed with the
+    ``rabit_wire_rs``/``rabit_wire_ag`` phase overrides and the
+    ``rabit_wire_block`` block size (parallel/wire.py spec grammar) —
+    only where measurement says it pays. Precedence: with
+    ``rabit_wire_adaptive`` on and telemetry carrying enough
+    unquantized ring-family samples, a live bandwidth-learned
+    crossover decides (:func:`_adaptive_elect`; single-controller
+    worlds only — a per-process election would be a divergent static
+    jit arg); else the table bucket's wire field; else ``n >=
+    wire_mincount()``. An EXPLICITLY configured mincount (the env var
+    is set) beats the table's wire column: a user who pins the gate —
+    e.g. ``rabit_dataplane_wire_mincount=0`` to force quantization at
+    demo sizes — must win over recorded policy, the same precedence
+    rule as the per-call override. No env wire (or a tree method) →
+    None. An explicit wire spec (``"bf16"``, ``"int8:bf16@512"``, …)
+    passes through, canonicalized (per-call override);
+    ``wire="none"``/None force it off. The applied wire and its
+    provenance are noted (:func:`note_wire`) so dataplane spans can
+    stamp request vs outcome.
     """
     import jax.numpy as jnp
 
@@ -258,26 +365,62 @@ def resolve(n: int, dtype, op: int, axis_size: int,
             adapted = True
             if method in ("swing", "bidir"):
                 method = ("tree" if n < RING_MINCOUNT_DEFAULT else "ring")
+    itemsize = jnp.dtype(dtype).itemsize
+    requested_wire = wire
+    wire_prov = ""
     if wire == "auto":
-        env_wire = os.environ.get(_WIRE_ENV) or None
+        # env request: base codec (rabit_dataplane_wire) with per-phase
+        # overrides (rabit_wire_rs/rabit_wire_ag) and the env block
+        # folded in — already canonical
+        env_wire = _wirespec.phase_request(
+            os.environ.get(_WIRE_ENV) or None)
         if (env_wire is None or method in ("tree", "preagg")
                 or not wire_eligible):
             wire = None
-        elif table is not None and not os.environ.get(_WIRE_MINCOUNT_ENV):
-            wire = env_wire if _bucket(table["float_sum"], n).get("wire") \
-                else None
         else:
-            wire = env_wire if n >= wire_mincount() else None
-    elif wire == "none":
+            elected = (_adaptive_elect(n, itemsize, env_wire)
+                       if wire_adaptive() else None)
+            if elected is not None:
+                # bandwidth-learned crossover (rabit_wire_adaptive)
+                # beats the static gate; still only ever engages the
+                # wire the user REQUESTED — lossy modes stay opt-in
+                wire = env_wire if elected else None
+                wire_prov = "adaptive"
+            elif (table is not None
+                    and not os.environ.get(_WIRE_MINCOUNT_ENV)):
+                wire = env_wire \
+                    if _bucket(table["float_sum"], n).get("wire") else None
+            else:
+                wire = env_wire if n >= wire_mincount() else None
+    elif wire in ("none", "off"):
         wire = None
+    else:
+        # explicit per-call spec: canonicalize (folds the env block into
+        # specs that don't pin one) so it is a stable jit cache key
+        wire = _wirespec.canonical_wire(wire)
     from .. import telemetry
+    provenance = ("explicit" if requested != "auto"
+                  else "skew_adapted" if adapted
+                  else "table" if table is not None else "fallback")
+    if not wire_prov:
+        wire_prov = ("explicit" if requested_wire not in ("auto",)
+                     else provenance)
     if telemetry.enabled():
-        provenance = ("explicit" if requested != "auto"
-                      else "skew_adapted" if adapted
-                      else "table" if table is not None else "fallback")
         if adapted:
             telemetry.count("dispatch.skew_adapted")
+        if wire_prov == "adaptive":
+            # adaptive election made the call (either way); the row's
+            # wire field says whether it engaged ("" = declined)
+            telemetry.count("dispatch.wire_adapted",
+                            nbytes=n * itemsize, wire=wire)
+        if wire is not None:
+            # bytes entering the quantized dataplane, by spec — served
+            # as rabit_wire_quantized_bytes_total (telemetry/prom.py)
+            telemetry.count("wire.quantized", nbytes=n * itemsize,
+                            op=OP_NAMES.get(op, str(op)), method=method,
+                            wire=wire, provenance=wire_prov)
         telemetry.record_dispatch(
-            n, jnp.dtype(dtype).itemsize, OP_NAMES.get(op, str(op)),
+            n, itemsize, OP_NAMES.get(op, str(op)),
             method, wire, provenance)
+    note_wire(wire, wire_prov)
     return method, wire

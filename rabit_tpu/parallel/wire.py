@@ -1,0 +1,249 @@
+"""Block-wise wire codec + wire-spec grammar for the ring-family
+collectives (EQuARX-style, arXiv:2506.17615).
+
+The collectives never ship whole payloads at reduced precision — only
+the ``lax.ppermute``'d bytes are compressed, and accumulation stays in
+f32 on-device (collectives.py). This module owns the two halves of that
+contract that are schedule-independent:
+
+**The spec grammar.** A wire spec is a string
+
+    "<rs>[:<ag>][@<block>]"
+
+where ``rs`` / ``ag`` are the reduce-scatter and all-gather phase
+codecs (``bf16`` | ``int8`` | ``none``; a single codec with no colon
+applies to both phases) and ``block`` is the int8 scaling-block size in
+elements. Examples: ``"int8"``, ``"int8:bf16"`` (quantize the
+accumulating RS hops harder than the verbatim-forwarded AG),
+``"bf16@512"``, ``"none:int8@2048"``. Specs are STATIC jit-cache keys,
+so they must be canonical before tracing: :func:`canonical_wire` folds
+the ``rabit_wire_block`` env default into any spec that doesn't pin its
+own block — env changes then retrace instead of silently reusing a
+stale compilation. The legacy whole-string forms ``"bf16"`` / ``"int8"``
+remain valid specs (symmetric phases, default block).
+
+**The codec.** ``bf16`` is a cast (half the bytes, no sidecar).
+``int8`` is per-block symmetric quantization: each ``block``-element
+block ships as int8 in [-127, 127] plus one f32 max-abs scale — a
+``4/block`` relative sidecar overhead, ~1/4 the f32 bytes at the
+default 1024 block. The scale is clamped BEFORE both the division and
+the shipped value so encode and decode agree bit-for-bit on every rank
+(the replay contract).
+
+``python -m rabit_tpu.parallel.wire --smoke`` round-trips the codec and
+exercises the adaptive election (run_tests.sh tier 0m).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+WIRE_BLOCK_DEFAULT = 1024
+
+_WIRE_BLOCK_ENV = "RABIT_WIRE_BLOCK"
+_WIRE_RS_ENV = "RABIT_WIRE_RS"
+_WIRE_AG_ENV = "RABIT_WIRE_AG"
+
+_CODECS = ("bf16", "int8")
+
+
+def wire_block() -> int:
+    """Env-configured default int8 scaling-block size
+    (``rabit_wire_block``; elements per shipped f32 scale). Falls back
+    to ``WIRE_BLOCK_DEFAULT`` on unset/garbage — a wire knob must never
+    crash dispatch."""
+    raw = os.environ.get(_WIRE_BLOCK_ENV, "")
+    if not raw:
+        return WIRE_BLOCK_DEFAULT
+    try:
+        block = int(raw)
+    except ValueError:
+        return WIRE_BLOCK_DEFAULT
+    return block if block > 0 else WIRE_BLOCK_DEFAULT
+
+
+def _norm_codec(c: str, spec: str) -> Optional[str]:
+    if c in ("", "none"):
+        return None
+    if c not in _CODECS:
+        raise ValueError(
+            f"wire spec {spec!r}: codec must be one of "
+            f"{_CODECS + ('none',)}, got {c!r}")
+    return c
+
+
+def parse_wire(spec: Optional[str]
+               ) -> Tuple[Optional[str], Optional[str], int]:
+    """``spec -> (rs_codec, ag_codec, block)``. Pure and env-independent
+    (a spec missing ``@block`` means ``WIRE_BLOCK_DEFAULT``): per-shard
+    code parses the canonical spec it was traced with, never the live
+    env — see :func:`canonical_wire`."""
+    if spec is None:
+        return None, None, WIRE_BLOCK_DEFAULT
+    body, at, blk = str(spec).partition("@")
+    block = WIRE_BLOCK_DEFAULT
+    if at:
+        try:
+            block = int(blk)
+        except ValueError:
+            raise ValueError(
+                f"wire spec {spec!r}: block must be an integer")
+        if block <= 0:
+            raise ValueError(
+                f"wire spec {spec!r}: block must be positive")
+    rs, colon, ag = body.partition(":")
+    if not colon:
+        ag = rs
+    return _norm_codec(rs, spec), _norm_codec(ag, spec), block
+
+
+def format_wire(rs: Optional[str], ag: Optional[str],
+                block: int = WIRE_BLOCK_DEFAULT) -> Optional[str]:
+    """Canonical spec string for the components, or None when both
+    phases are unquantized (no-wire is spelled None, never "none")."""
+    if rs is None and ag is None:
+        return None
+    body = (rs or "none") if rs == ag else f"{rs or 'none'}:{ag or 'none'}"
+    if block != WIRE_BLOCK_DEFAULT:
+        body += f"@{block}"
+    return body
+
+
+def canonical_wire(spec: Optional[str]) -> Optional[str]:
+    """Host-side canonicalization — the ONLY place the env block knob
+    enters a spec. Call before a spec becomes a static jit argument:
+    a spec that doesn't pin ``@block`` gets the live ``rabit_wire_block``
+    value folded in, so two runs with different env blocks trace
+    different programs instead of sharing a cache entry keyed on the
+    bare string."""
+    if spec in (None, "", "none", "off"):
+        return None
+    rs, ag, block = parse_wire(spec)
+    if "@" not in str(spec):
+        block = wire_block()
+    return format_wire(rs, ag, block)
+
+
+def phase_request(base: Optional[str]) -> Optional[str]:
+    """Compose the env-requested wire spec from the base codec
+    (``rabit_dataplane_wire``) and the per-phase overrides
+    (``rabit_wire_rs`` / ``rabit_wire_ag``). Either override alone is a
+    request — ``rabit_wire_rs=int8`` with no base quantizes only the
+    reduce-scatter hops. Returns a canonical spec or None."""
+    rs = os.environ.get(_WIRE_RS_ENV) or base
+    ag = os.environ.get(_WIRE_AG_ENV) or base
+    if rs in (None, "", "none", "off"):
+        rs = None
+    if ag in (None, "", "none", "off"):
+        ag = None
+    if rs is None and ag is None:
+        return None
+    if rs not in _CODECS + (None,) or ag not in _CODECS + (None,):
+        return None  # garbage env: a knob must never crash dispatch
+    return format_wire(rs, ag, wire_block())
+
+
+def wire_itemsize(spec: Optional[str], itemsize: float) -> float:
+    """Mean shipped bytes per element under ``spec`` (RS and AG phases
+    averaged — each carries half the round trip), used by the analytic
+    cost model and the adaptive election. ``itemsize`` is the raw
+    element size the unquantized phases ship."""
+    if spec is None:
+        return float(itemsize)
+    rs, ag, block = parse_wire(spec)
+    per = {None: float(itemsize), "bf16": 2.0,
+           "int8": 1.0 + 4.0 / block}
+    return (per[rs] + per[ag]) / 2.0
+
+
+def encode(x, codec: str, block: int = WIRE_BLOCK_DEFAULT):
+    """Encode an array for the wire: a tuple of arrays to ppermute.
+    ``bf16`` casts; ``int8`` block-quantizes (total element count must
+    tile into ``block``-element blocks) and ships the f32 max-abs
+    scales as a sidecar."""
+    import jax.numpy as jnp
+    if codec == "bf16":
+        return (x.astype(jnp.bfloat16),)
+    # int8: per-block symmetric scale, values in [-127, 127]. The scale
+    # is clamped BEFORE both the division and the shipped value so
+    # encode and decode agree (an unclamped shipped scale would decode
+    # denormal-scale blocks up to 127x too small).
+    blocks = x.reshape(-1, block)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0, 1e-30)
+    q = jnp.round(blocks / scale).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def decode(enc, codec: str, shape):
+    """Inverse of :func:`encode`; always returns f32 (the EQuARX
+    accumulate-in-full-precision half of the contract — callers cast
+    down only at the very end)."""
+    import jax.numpy as jnp
+    if codec == "bf16":
+        return enc[0].astype(jnp.float32).reshape(shape)
+    q, scale = enc
+    return (q.astype(jnp.float32) * scale).reshape(shape)
+
+
+def _smoke() -> int:
+    """Tier-0m CI smoke: codec round-trips within the documented error
+    envelopes at several block sizes, spec grammar is total, and the
+    adaptive election elects/declines from synthetic telemetry."""
+    import numpy as np
+
+    # spec grammar: parse/format closure
+    cases = {
+        "bf16": ("bf16", "bf16", 1024), "int8": ("int8", "int8", 1024),
+        "int8:bf16": ("int8", "bf16", 1024),
+        "none:int8@512": (None, "int8", 512),
+        "bf16@2048": ("bf16", "bf16", 2048),
+    }
+    for spec, want in cases.items():
+        got = parse_wire(spec)
+        assert got == want, (spec, got, want)
+        assert parse_wire(format_wire(*got)) == want, spec
+    assert format_wire(None, None) is None
+    for junk in ("fp8", "int8@0", "int8@x", "bf16:fp4"):
+        try:
+            parse_wire(junk)
+        except ValueError:
+            pass
+        else:
+            raise AssertionError(f"parse_wire accepted {junk!r}")
+
+    # codec round-trip: relative error inside the per-mode envelope
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(8192).astype(np.float32)
+    for codec, block, tol in (("bf16", 1024, 8e-3), ("int8", 256, 1e-2),
+                              ("int8", 1024, 1e-2), ("int8", 4096, 2e-2)):
+        y = np.asarray(decode(encode(x, codec, block), codec, x.shape))
+        rel = np.abs(y - x).max() / np.abs(x).max()
+        assert 0 < rel < tol, (codec, block, rel)
+    print("wire-smoke: codec round-trips OK")
+
+    # adaptive election: a measured-slow fabric elects the wire, a
+    # measured-fast one declines it (synthetic counters, no device)
+    from .. import telemetry
+    from . import dispatch
+    telemetry.reset(enabled=True)
+    n, itemsize = 1 << 20, 4
+    for bw_gbps, want in ((0.05, True), (1000.0, False)):
+        telemetry.reset(enabled=True)
+        for _ in range(8):
+            telemetry._REC.record_span(
+                "allreduce", (n * itemsize) / (bw_gbps * 1e9),
+                nbytes=n * itemsize, method="ring")
+        got = dispatch._adaptive_elect(n, itemsize, "int8:bf16")
+        assert got is want, (bw_gbps, got)
+    telemetry.reset(enabled=True)
+    assert dispatch._adaptive_elect(n, itemsize, "int8") is None
+    telemetry.reset(enabled=False)
+    print("wire-smoke: adaptive election OK")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - CI entry
+    import sys
+    sys.exit(_smoke())

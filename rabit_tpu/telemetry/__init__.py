@@ -89,6 +89,12 @@ def snapshot() -> dict:
     return _REC.snapshot()
 
 
+def counter_rows(name: str) -> list:
+    """Aggregated counter rows for one name (recorder keying) — the
+    policy-plane read behind dispatch's adaptive wire election."""
+    return _REC.counter_rows(name)
+
+
 def stats() -> dict:
     """Recorder occupancy counters (tests and doctors)."""
     return {"enabled": _REC.enabled, "capacity": _REC.capacity,

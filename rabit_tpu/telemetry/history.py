@@ -113,15 +113,19 @@ def extract_metrics(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
         if key in doc:
             add(key, doc.get(key), "s")
     if doc.get("schema") in ("rabit_tpu.collective_sweep/v1",
-                             "rabit_tpu.collective_sweep/v2") \
+                             "rabit_tpu.collective_sweep/v2",
+                             "rabit_tpu.collective_sweep/v3") \
             and not doc.get("smoke"):  # smoke timings are noise by design
         # one series per (section, method, wire, size): the sentinel
         # then trends every schedule's s_per_op across committed sweeps
-        # — a slowed-down hier inter phase fails CI like any perf bug
+        # — a slowed-down hier inter phase fails CI like any perf bug.
+        # v3 wire values are phase-split specs ("int8:bf16@512"); the
+        # separators fold to "_" so a series name stays one dotted token
         for r in doc.get("rows", []):
             if not isinstance(r, dict):
                 continue
-            wire = f"_{r['wire']}" if r.get("wire") else ""
+            wire = (f"_{r['wire']}".replace(":", "_").replace("@", "_b")
+                    if r.get("wire") else "")
             add(f"sweep_s_per_op.{r.get('section')}.{r.get('method')}"
                 f"{wire}.n_{r.get('n')}", r.get("s_per_op"), "s")
     return out

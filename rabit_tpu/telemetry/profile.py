@@ -51,9 +51,25 @@ ENV_ENABLED = "RABIT_PROFILE"
 ENV_POLL_MS = "RABIT_PROFILE_MEMORY_POLL_MS"
 MEMORY_POLL_MS_DEFAULT = 500
 
-# bytes shipped per element by wire mode (int8 adds one f32 scale per
-# 256-element block — see parallel/wire.py)
-_WIRE_ITEMSIZE = {"bf16": 2.0, "int8": 1.0 + 4.0 / 256.0}
+# bytes shipped per element for the legacy symmetric wire modes (int8
+# adds one f32 scale per 1024-element block — see parallel/wire.py).
+# Phase-split / custom-block specs ("int8:bf16", "bf16@512", ...) are
+# delegated to parallel.wire.wire_itemsize lazily, so this module stays
+# importable without the accelerator stack.
+_WIRE_ITEMSIZE = {"bf16": 2.0, "int8": 1.0 + 4.0 / 1024.0}
+
+
+def _wire_itemsize_of(wire: Optional[str], itemsize: int) -> float:
+    if not wire:
+        return float(itemsize)
+    b = _WIRE_ITEMSIZE.get(wire)
+    if b is not None:
+        return b
+    try:
+        from ..parallel.wire import wire_itemsize
+        return wire_itemsize(wire, itemsize)
+    except (ImportError, ValueError):
+        return float(itemsize)
 
 
 def _env_enabled() -> bool:
@@ -86,7 +102,7 @@ def collective_cost(method: Optional[str], n: int, itemsize: int,
     n = max(0, int(n))
     if p == 1 or n == 0:
         return {"flops": 0, "wire_bytes": 0, "hops": 0}
-    wire_b = _WIRE_ITEMSIZE.get(wire or "", float(itemsize))
+    wire_b = _wire_itemsize_of(wire, itemsize)
     if (method == "hier" and group_size and 1 < group_size < p
             and p % group_size == 0):
         g, hosts = group_size, p // group_size

@@ -97,6 +97,9 @@ METRIC_FAMILIES = (
     "rabit_admission_queued_total",
     "rabit_admission_shed_total",
     "rabit_job_quarantined_total",
+    # in-collective wire quantization (parallel/dispatch.py, ISSUE 16)
+    "rabit_wire_quantized_bytes_total",
+    "rabit_wire_adapted_total",
 )
 
 
@@ -220,6 +223,18 @@ def render_prometheus(sources: Iterable[Tuple[Dict[str, str], dict]],
         "ovl_hidden": _Family("rabit_collective_overlap_hidden_ms_total",
                               "Wire milliseconds hidden behind compute "
                               "between issue and wait.", "counter"),
+        # in-collective wire quantization: dedicated families carved
+        # out of the recorder counter rows so dashboards can rate()
+        # quantized traffic and adaptive elections without label-
+        # matching the generic collective counters
+        "wire_q_bytes": _Family("rabit_wire_quantized_bytes_total",
+                                "Payload bytes resolved onto a "
+                                "quantized wire per (op,method,wire,"
+                                "provenance).", "counter"),
+        "wire_adapted": _Family("rabit_wire_adapted_total",
+                                "Adaptive wire elections made by "
+                                "dispatch per (op,method,wire).",
+                                "counter"),
     }
     for base, doc in sources:
         base = dict(base or {})
@@ -230,6 +245,12 @@ def render_prometheus(sources: Iterable[Tuple[Dict[str, str], dict]],
         if "enabled" in doc:
             fams["enabled"].add(base, bool(doc["enabled"]))
         for row in doc.get("counters", []):
+            if row.get("name") == "wire.quantized":
+                fams["wire_q_bytes"].add(_counter_labels(row, base),
+                                         int(row.get("bytes", 0)))
+            elif row.get("name") == "dispatch.wire_adapted":
+                fams["wire_adapted"].add(_counter_labels(row, base),
+                                         int(row.get("count", 0)))
             labels = _counter_labels(row, base)
             fams["count"].add(labels, int(row.get("count", 0)))
             fams["bytes"].add(labels, int(row.get("bytes", 0)))
@@ -288,7 +309,8 @@ def render_prometheus(sources: Iterable[Tuple[Dict[str, str], dict]],
              "dropped", "capacity", "enabled", "compile_n", "compile_s",
              "compile_max", "jit_hits", "jit_misses", "cost_flops",
              "cost_bytes", "ovl_ops", "ovl_exposed", "ovl_hidden",
-             "mem_live", "mem_peak", "mem_arrays")
+             "mem_live", "mem_peak", "mem_arrays",
+             "wire_q_bytes", "wire_adapted")
     for key in order:
         lines.extend(fams[key].lines())
     for name, help_text, mtype, samples in gauges:

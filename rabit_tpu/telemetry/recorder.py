@@ -225,6 +225,24 @@ class Recorder:
             h = c["hist_log2_us"]
             h[exp] = h.get(exp, 0) + 1
 
+    def counter_rows(self, name: str) -> list:
+        """Aggregated counter rows for one span/counter name — a cheap
+        policy-plane read (no span-ring copy; dispatch's adaptive wire
+        election calls this per resolve)."""
+        out = []
+        with self._lock:
+            for (nm, op, method, wire, bucket, prov), c in \
+                    self._counters.items():
+                if nm != name:
+                    continue
+                out.append({"name": nm, "op": op, "method": method,
+                            "wire": wire, "bucket": bucket,
+                            "provenance": prov, "count": c["count"],
+                            "bytes": c["bytes"],
+                            "total_s": c["total_s"],
+                            "max_s": c["max_s"]})
+        return out
+
     # -- snapshots --------------------------------------------------------
     def snapshot(self) -> dict:
         """Point-in-time copy: spans in chronological order, counter

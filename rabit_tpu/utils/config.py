@@ -31,6 +31,10 @@ ENV_VARS = [
     "RABIT_DATAPLANE_MINBYTES",
     "RABIT_DATAPLANE_WIRE",
     "RABIT_DATAPLANE_WIRE_MINCOUNT",
+    "RABIT_WIRE_BLOCK",
+    "RABIT_WIRE_RS",
+    "RABIT_WIRE_AG",
+    "RABIT_WIRE_ADAPTIVE",
     "RABIT_REDUCE_METHOD",
     "RABIT_HIER",
     "RABIT_HIER_GROUP",

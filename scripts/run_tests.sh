@@ -84,6 +84,13 @@ echo "== tier 0l: multi-job smoke (submit -> two worlds -> admission) =="
 # hint, and closing a live job admits the queued one
 python -m rabit_tpu.tracker.jobs --smoke
 
+echo "== tier 0m: wire-quantization smoke (encode -> decode -> elect) =="
+# block-quantized codec round-trips inside the documented error
+# envelopes at several block sizes, the wire-spec grammar is total
+# (junk rejected), and the adaptive election elects on a measured-slow
+# fabric and declines on a fast one — pure host-side, no device mesh
+JAX_PLATFORMS=cpu python -m rabit_tpu.parallel.wire --smoke
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

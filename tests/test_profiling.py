@@ -98,9 +98,15 @@ def test_collective_cost_wire_scales_bytes_not_flops():
     bf16 = profile.collective_cost("ring", 256, 4, 4, wire="bf16")
     int8 = profile.collective_cost("ring", 256, 4, 4, wire="int8")
     assert bf16["wire_bytes"] == f32["wire_bytes"] // 2
-    # int8 pays one f32 scale per 256-element block on top of 1 B/elem
-    assert int8["wire_bytes"] == int(2 * 256 * 3 / 4 * (1 + 4 / 256))
+    # int8 pays one f32 scale per scaling block (default 1024 elems) on
+    # top of 1 B/elem
+    assert int8["wire_bytes"] == int(2 * 256 * 3 / 4 * (1 + 4 / 1024))
     assert f32["flops"] == bf16["flops"] == int8["flops"]
+    # phase-split / custom-block specs resolve through parallel.wire:
+    # "int8:bf16@512" ships (1 + 4/512 + 2)/2 bytes per element
+    mixed = profile.collective_cost("ring", 256, 4, 4, wire="int8:bf16@512")
+    assert mixed["wire_bytes"] == int(2 * 256 * 3 / 4
+                                      * (1 + 4 / 512 + 2.0) / 2)
 
 
 def test_collective_cost_degenerate_worlds_are_free():

@@ -1,8 +1,8 @@
 #!/usr/bin/env python
 """Measured crossover sweep for the collective dispatch table.
 
-Times {tree, ring, bidir, swing, hier} x {wire none/bf16/int8} x
-payload sizes on the device mesh (virtual CPU mesh by default — the
+Times {tree, ring, bidir, swing, hier} x {wire none/bf16/int8/int8:bf16}
+x payload sizes on the device mesh (virtual CPU mesh by default — the
 same gloo fabric the XLA data plane uses in tests; on a real TPU slice
 the same sweep measures ICI) and derives the per-size-bucket dispatch
 table that ``device_allreduce(method="auto")`` loads
@@ -38,13 +38,21 @@ imbalanced arrival pattern. Each emitted row then carries
 reason for the v2 schema bump (dispatch.py still loads committed v1
 artifacts).
 
+The v3 bump adds the block-quantized wire columns: wire values are now
+full phase-split specs (``"int8:bf16"`` quantizes the accumulating
+reduce-scatter hops to int8 blocks and the verbatim-forwarded
+all-gather hops to bf16 — the EQuARX asymmetry, parallel/wire.py) and
+``--wire-block B`` pins the int8 scaling-block size into the swept
+specs (``"int8@B"``); every row records its ``wire_block``. dispatch.py
+still loads committed v2/v1 artifacts.
+
 Writes ``COLLECTIVE_SWEEP_<ts>.json`` (schema
-``rabit_tpu.collective_sweep/v2``) under ``benchmarks/artifacts/``,
+``rabit_tpu.collective_sweep/v3``) under ``benchmarks/artifacts/``,
 where ``parallel/dispatch.py`` discovers the newest one.
 
 Usage: python tools/collective_sweep.py [--smoke] [--world N]
                                         [--lag-rank N] [--lag-ms M]
-                                        [--out PATH]
+                                        [--wire-block B] [--out PATH]
   --smoke   CI contract check: one tiny size, noisy timing allowed,
             still emits a schema-valid artifact (to --out if given).
 """
@@ -63,7 +71,18 @@ sys.path.insert(0, REPO)
 
 FULL_SIZES = [4096, 32768, 262144, 2097152]
 SMOKE_SIZES = [4096]
-WIRES = (None, "bf16", "int8")
+# quantized wire columns: the symmetric legacy modes plus the EQuARX
+# asymmetric phase split (int8 RS / bf16 AG). --wire-block pins "@B"
+# onto the int8-bearing specs at sweep time.
+WIRES = (None, "bf16", "int8", "int8:bf16")
+
+
+def _wire_columns(wire_block: int):
+    from rabit_tpu.parallel.wire import WIRE_BLOCK_DEFAULT
+    if wire_block == WIRE_BLOCK_DEFAULT:
+        return WIRES
+    return tuple(w if w is None or "int8" not in w
+                 else f"{w}@{wire_block}" for w in WIRES)
 
 
 def _ensure_devices(world: int) -> None:
@@ -174,7 +193,8 @@ def _check_correct(mesh, axis, method, wire, dtype, op,
 
 
 def sweep(world: int, sizes, smoke: bool, ranks_per_host: int = 2,
-          lag_rank=None, lag_ms: float = 0.0) -> dict:
+          lag_rank=None, lag_ms: float = 0.0,
+          wire_block: int = 0) -> dict:
     import jax
 
     from rabit_tpu.ops.reducers import SUM
@@ -199,6 +219,10 @@ def sweep(world: int, sizes, smoke: bool, ranks_per_host: int = 2,
         if ranks_per_host > 1 else None
     if not topology.is_hierarchical(groups, world):
         groups = None
+    from rabit_tpu.parallel.wire import WIRE_BLOCK_DEFAULT
+    if wire_block <= 0:
+        wire_block = WIRE_BLOCK_DEFAULT
+    wire_cols = _wire_columns(wire_block)
     k_small, k_big = (2, 4) if smoke else (2, 8)
     lagging = lag_rank is not None and lag_ms > 0
     if lagging and not 0 <= lag_rank < world:
@@ -211,7 +235,8 @@ def sweep(world: int, sizes, smoke: bool, ranks_per_host: int = 2,
             if method == "hier" and groups is None:
                 continue
             g = groups if method == "hier" else None
-            wires = (WIRES if section == "float_sum" and method != "tree"
+            wires = (wire_cols
+                     if section == "float_sum" and method != "tree"
                      else (None,))
             for wire in wires:
                 _check_correct(mesh, "sweep", method, wire, dtype, op,
@@ -225,12 +250,14 @@ def sweep(world: int, sizes, smoke: bool, ranks_per_host: int = 2,
                                    allow_noisy=smoke)
                     row = {"section": section, "method": method,
                            "wire": wire, "n": n, "s_per_op": s,
+                           "wire_block": (wire_block if wire
+                                          and "int8" in wire else None),
                            "lag_rank": lag_rank if lagging else None,
                            "lag_ms": lag_ms if lagging else 0.0}
                     rows.append(row)
                     print(json.dumps(row), flush=True)
     return {"world": world, "backend": jax.default_backend(),
-            "k": [k_small, k_big],
+            "k": [k_small, k_big], "wire_block": wire_block,
             "ranks_per_host": ranks_per_host if groups else 1,
             "lag": ({"rank": lag_rank, "ms": lag_ms, "iters": lag_iters}
                     if lagging else None),
@@ -287,6 +314,9 @@ def main() -> None:
                          "collective (skew-crossover measurement)")
     ap.add_argument("--lag-ms", type=float, default=0.0,
                     help="calibrated per-collective burn on --lag-rank")
+    ap.add_argument("--wire-block", type=int, default=0,
+                    help="int8 scaling-block size pinned into the swept "
+                         "wire specs (0: parallel/wire.py default)")
     ap.add_argument("--out", default=None,
                     help="artifact path (default: repo root, timestamped)")
     args = ap.parse_args()
@@ -299,7 +329,8 @@ def main() -> None:
     sizes = SMOKE_SIZES if args.smoke else FULL_SIZES
     result = sweep(args.world, sizes, args.smoke,
                    ranks_per_host=args.ranks_per_host,
-                   lag_rank=args.lag_rank, lag_ms=args.lag_ms)
+                   lag_rank=args.lag_rank, lag_ms=args.lag_ms,
+                   wire_block=args.wire_block)
     result["schema"] = SCHEMA
     result["table"] = derive_table(result["rows"], sizes)
     ts = datetime.datetime.now(datetime.timezone.utc).strftime(
