@@ -357,6 +357,7 @@ class NativeEngine(Engine):
         """Watchdog/recovery gauges served on /metrics next to the
         recorder counters (recovery *events* are counter rows already;
         these are the current-state reads)."""
+        from ..telemetry import slo as _slo
         retries = ctypes.c_uint64()
         rejects = ctypes.c_uint64()
         self._lib.RbtRecoveryStats(ctypes.byref(retries),
@@ -376,6 +377,9 @@ class NativeEngine(Engine):
             ("rabit_frame_crc_rejects_total",
              "CRC-rejected collective frames (retransmitted hop-local).",
              "counter", [({}, int(rejects.value))]),
+            # per-rank SLO burn: this rank's p99 collective latency
+            # judged against the fleet objective (telemetry/slo.py)
+            *_slo.rank_gauges(),
         ]
 
     @property

@@ -191,10 +191,14 @@ class XlaEngine(Engine):
                                     self._metrics_server.port, self._rank)
 
     def _live_gauges(self):
+        from ..telemetry import slo as _slo
         return [
             ("rabit_watchdog_expired_total",
              "Watchdog deadline expiries in this process.", "counter",
              [({}, self._watchdog.expired_total)]),
+            # per-rank SLO burn: this rank's p99 collective latency
+            # judged against the fleet objective (telemetry/slo.py)
+            *_slo.rank_gauges(),
         ]
 
     def _hier_phase_guard(self, name: str, nbytes: int):

@@ -44,6 +44,17 @@ REL_FLOOR = 0.01
 
 # units where smaller is better; everything else defaults higher-better
 _LOWER_UNITS = frozenset({"s", "ms", "us", "seconds", "sec"})
+# explicit per-metric direction registrations (ISSUE 17): the unit
+# heuristic cannot know that a fraction-valued series like fleet
+# availability gates on DROPS — sources that know better say so here.
+# Seeded with the soak SLO series so a bare sentinel run judges a
+# committed soak artifact correctly without importing the soak tool.
+_DIRECTIONS: Dict[str, str] = {
+    "soak_availability": "higher",
+    "soak_p99_ms": "lower",
+    "soak_failover_ms": "lower",
+    "soak_shed_rate": "lower",
+}
 # artifact keys that are measurements/noise, never configuration
 _NON_CONFIG_KEYS = frozenset({
     "value", "vs_baseline", "correct", "timestamp_utc", "t_dev_ms",
@@ -62,7 +73,21 @@ def history_path(root: Optional[str] = None) -> str:
     return os.path.join(root or repo_root(), "benchmarks", "history.jsonl")
 
 
+def register_direction(metric: str, direction: str) -> None:
+    """Declare which way is better for one metric series. Beats the
+    unit/suffix heuristic in :func:`_direction` — the API for
+    higher-is-better series whose unit says nothing (fractions,
+    ratios, counts-per-round)."""
+    if direction not in ("lower", "higher"):
+        raise ValueError(f"direction must be 'lower' or 'higher', "
+                         f"got {direction!r}")
+    _DIRECTIONS[str(metric)] = direction
+
+
 def _direction(metric: str, unit: str) -> str:
+    reg = _DIRECTIONS.get(metric)
+    if reg is not None:
+        return reg
     u = str(unit).strip().lower()
     if u in _LOWER_UNITS or metric.endswith(("_s", "_ms", "_seconds")):
         return "lower"
@@ -128,6 +153,18 @@ def extract_metrics(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
                     if r.get("wire") else "")
             add(f"sweep_s_per_op.{r.get('section')}.{r.get('method')}"
                 f"{wire}.n_{r.get('n')}", r.get("s_per_op"), "s")
+    if doc.get("schema") == "rabit_tpu.soak/v1" \
+            and not doc.get("smoke"):  # smoke soaks are noise by design
+        # one series per SLO verdict; the verdict's own direction is
+        # authoritative (availability is a higher-is-better fraction —
+        # the unit heuristic alone would gate it the wrong way)
+        for v in doc.get("slos", []):
+            if not isinstance(v, dict) or not v.get("slo"):
+                continue
+            metric = str(v.get("metric") or f"soak_{v['slo']}")
+            if v.get("direction") in ("lower", "higher"):
+                register_direction(metric, v["direction"])
+            add(metric, v.get("value"), str(v.get("unit", "")))
     return out
 
 

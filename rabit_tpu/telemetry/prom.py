@@ -100,6 +100,12 @@ METRIC_FAMILIES = (
     # in-collective wire quantization (parallel/dispatch.py, ISSUE 16)
     "rabit_wire_quantized_bytes_total",
     "rabit_wire_adapted_total",
+    # SLO plane (telemetry/slo.py, tracker/tracker.py, ISSUE 17)
+    "rabit_slo_state",
+    "rabit_slo_objective",
+    "rabit_slo_value",
+    "rabit_slo_burn_ratio",
+    "rabit_failover_duration_ms",
 )
 
 

@@ -305,6 +305,14 @@ class StandbyTracker:
             pass
         with self._mu:
             last_lease = self._lease
+            lease_deadline = self._lease_deadline
+        # failover clock (ISSUE 17): the countdown deadline sits one
+        # full lease past the LAST frame the leader delivered, so
+        # deadline - lease is the leader's last proof of life — the
+        # instant the failover duration starts counting
+        detect_mono = (lease_deadline - self.lease_ms / 1e3
+                       if lease_deadline is not None
+                       else time.monotonic())
         self._log(f"no leader frame for a full lease "
                   f"({self.lease_ms}ms, last lease {last_lease}); "
                   f"promoting on {self.host}:{self.port} from seq "
@@ -329,10 +337,24 @@ class StandbyTracker:
                     return
                 time.sleep(0.05)
         tr.promoted = True
+        # stamp BOTH clocks at promotion (wall for humans and
+        # cross-host logs, monotonic for the arithmetic) and journal
+        # the measured leader-kill -> promoted duration so the control
+        # plane itself reports failover time (rabit_failover_duration_ms
+        # gauge; a later resume replays the record and keeps serving it)
+        now_mono = time.monotonic()
+        tr.promoted_wall = time.time()
+        tr.promoted_mono = now_mono
+        tr.failover_duration_ms = max(0.0,
+                                      (now_mono - detect_mono) * 1e3)
+        tr._wal("promoted", node=self.node_id,
+                wall=round(tr.promoted_wall, 6),
+                mono=round(tr.promoted_mono, 6),
+                failover_ms=round(tr.failover_duration_ms, 3))
         tr.start()
         with self._mu:
             self.tracker = tr
-            self.promoted_at = time.monotonic()
+            self.promoted_at = now_mono
         self._note_promotion()
 
     def _note_promotion(self) -> None:

@@ -189,6 +189,8 @@ def _default_ready_timeout() -> float:
 
 RESUME_GRACE_MS_DEFAULT = 15_000
 
+FORMING_TIMEOUT_MS_DEFAULT = 0
+
 LEASE_MS_DEFAULT = 2_000
 REPL_ACK_TIMEOUT_MS_DEFAULT = 1_000
 
@@ -240,6 +242,25 @@ def resume_grace_ms() -> int:
             f"got {v!r}")
 
 
+def forming_timeout_ms() -> int:
+    """``rabit_job_forming_timeout_ms`` (doc/parameters.md): close an
+    open multi-job that has held an admission slot this long with no
+    registered rank, no pending registration, and no wire contact
+    (0 disables, the default). Guards a serving fleet against ghost
+    jobs — admitted from the FIFO queue after their submitter gave up
+    waiting, or flooded in by a submit storm — that would otherwise
+    jam admission capacity forever."""
+    v = os.environ.get("RABIT_JOB_FORMING_TIMEOUT_MS")
+    if not v:
+        return FORMING_TIMEOUT_MS_DEFAULT
+    try:
+        return max(0, int(v))
+    except ValueError:
+        raise ValueError(
+            f"RABIT_JOB_FORMING_TIMEOUT_MS must be an integer (ms), "
+            f"got {v!r}")
+
+
 class Tracker:
     def __init__(self, nworkers: int, host: str = "127.0.0.1", port: int = 0,
                  coordinator: bool = False,
@@ -285,6 +306,18 @@ class Tracker:
         self._admission = _jobs_mod.AdmissionQueue()   # fleet-global
         self._max_jobs = _jobs_mod.max_jobs()          # fleet-global: cap
         self._max_fleet_ranks = _jobs_mod.max_fleet_ranks()  # fleet-global
+        # admitted-verdict tally: with queued_total/shed_total it is
+        # the shed-rate SLO's denominator (telemetry/slo.py, ISSUE 17)
+        self.submit_admitted_total = 0                 # fleet-global
+        # jobs re-adopted from the WAL whose membership has not yet
+        # re-presented: if none of a job's tasks makes wire contact
+        # within the resume grace window, the job is dead weight from
+        # before the crash — the reaper closes it ("orphaned") so it
+        # stops eating admission capacity forever
+        self._orphan_jobs: set = set()                 # fleet-global
+        # last wire contact per job (monotonic, stamped at open):
+        # feeds the forming-timeout ghost-job reaper
+        self._job_contact: Dict[str, float] = {}       # fleet-global
         self.sock = socket.socket(socket.AF_INET,  # fleet-global: listener
                                   socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -361,6 +394,14 @@ class Tracker:
         self.lease_ms = int(lease_ms) if lease_ms else None  # fleet-global
         self.node_id = str(node_id)         # fleet-global: identity
         self.promoted = False               # fleet-global: standby flag
+        # failover measurement (ISSUE 17): the standby stamps both
+        # clocks at promotion — wall for humans and cross-host logs,
+        # monotonic for the duration arithmetic — plus the measured
+        # leader-kill -> promoted duration, journaled as a "promoted"
+        # record so a later resume keeps serving the same gauge
+        self.promoted_wall = 0.0            # fleet-global: failover stamp
+        self.promoted_mono = 0.0            # fleet-global: failover stamp
+        self.failover_duration_ms = 0.0     # fleet-global: failover span
         self._lease: Optional[dict] = None  # fleet-global: leadership
         self._lease_thread: Optional[threading.Thread] = None  # fleet-global
         # the replication side never touches self._lock (``_wal`` runs
@@ -473,11 +514,14 @@ class Tracker:
                     self._jobs[jid] = _jobs_mod.JobState(
                         jid, int(data.get("nworkers", self.nworkers)),
                         elastic=bool(data.get("elastic", False)))
+                    if jid != _jobs_mod.DEFAULT_JOB:
+                        self._orphan_jobs.add(jid)
                 continue
             if kind == "job_close":
                 closing = self._jobs.get(jid)
                 if closing is not None:
                     closing.close(str(data.get("reason", "")))
+                self._orphan_jobs.discard(jid)
                 continue
             job = self._jobs.get(jid)
             if job is None:
@@ -487,6 +531,8 @@ class Tracker:
                 job = _jobs_mod.JobState(jid, self.nworkers,
                                          elastic=self.elastic)
                 self._jobs[jid] = job
+                if jid != _jobs_mod.DEFAULT_JOB:
+                    self._orphan_jobs.add(jid)
             if kind == "assign":
                 job._ranks[str(data["task"])] = int(data["rank"])
             elif kind == "epoch":
@@ -512,6 +558,13 @@ class Tracker:
                 job._shutdown_ranks.add(int(data["rank"]))
             elif kind == "resume":
                 self.restarts = int(data.get("restarts", self.restarts))
+            elif kind == "promoted":
+                # a journaled failover outlives the promoted process:
+                # a later resume keeps reporting the measured duration
+                self.promoted_wall = float(data.get("wall", 0.0))
+                self.promoted_mono = float(data.get("mono", 0.0))
+                self.failover_duration_ms = float(
+                    data.get("failover_ms", 0.0))
             elif kind == _wal_mod.LEASE_KIND:
                 self._lease = dict(data)
                 self._journaled_lease = dict(data)
@@ -925,7 +978,8 @@ class Tracker:
                 gauges_fn=self._live_gauges,
                 identity=identity,
                 routes={"/straggler": self._straggler_doc,
-                        "/jobs": self._jobs_doc},
+                        "/jobs": self._jobs_doc,
+                        "/slo": self._slo_doc},
             ).start()
         except OSError as e:
             print(f"[tracker] metrics server failed to bind port "
@@ -1130,7 +1184,48 @@ class Tracker:
                 "(exceptions that never reached the accept loop).",
                 "counter", [(self._jl(s["id"]), s["quarantined"])
                             for s in snap]))
+        if self.promoted:
+            gauges.append((
+                "rabit_failover_duration_ms",
+                "Leader-kill to standby-promoted duration, stamped by "
+                "the control plane at promotion (tracker/standby.py).",
+                "gauge", [({"node": self.node_id},
+                           round(self.failover_duration_ms, 3))]))
+        if self.multi_job or self.lease_ms:
+            # SLO burn gauges ride along only where the SLO plane has
+            # something to measure (admission or failover) — a plain
+            # single-job tracker's exposition stays byte-identical
+            from ..telemetry import slo as _slo
+            gauges.extend(_slo.gauges(self._slo_verdicts()))
         return gauges
+
+    def _slo_verdicts(self) -> list:
+        """Tracker-side SLO measurements (telemetry/slo.py): the
+        objectives the control plane can see on its own — failover
+        time (once promoted) and admission shed rate. Availability
+        and collective latency are fleet-side (per-rank histograms,
+        the soak harness's round ledger)."""
+        from ..telemetry import slo as _slo
+        with self._lock:
+            shed = self._admission.shed_total
+            queued = self._admission.queued_total
+            admitted = self.submit_admitted_total
+        measured: Dict[str, float] = {}
+        if self.promoted and self.failover_duration_ms > 0:
+            measured["failover_ms"] = self.failover_duration_ms
+        total = admitted + queued + shed
+        if total:
+            measured["shed_rate"] = shed / total
+        slos = [s for s in _slo.default_slos()
+                if s.name in ("failover_ms", "shed_rate")]
+        return _slo.evaluate_all(slos, measured)
+
+    def _slo_doc(self) -> dict:
+        """The ``/slo`` route: per-objective burn state
+        (capture_status.py --live folds ``worst`` into the status
+        line)."""
+        from ..telemetry import slo as _slo
+        return _slo.burn_doc(self._slo_verdicts())
 
     def _straggler_doc(self) -> dict:
         """The ``/straggler`` route: the default job's snapshot (shape
@@ -1526,7 +1621,11 @@ class Tracker:
         """The named job, or None when unknown (commands for a job
         that was never admitted answer not-ok rather than raising)."""
         with self._lock:
-            return self._jobs.get(job_id)
+            job = self._jobs.get(job_id)
+            if job is not None and job.open:
+                self._orphan_jobs.discard(job_id)   # wire contact
+                self._job_contact[job_id] = time.monotonic()
+            return job
 
     def _job_for_register(self, job_id: str):
         """Resolve a registration's job: an existing open job, the
@@ -1538,6 +1637,8 @@ class Tracker:
         with self._lock:
             job = self._jobs.get(job_id)
             if job is not None and job.open:
+                self._orphan_jobs.discard(job_id)   # wire contact
+                self._job_contact[job_id] = time.monotonic()
                 return job
             if job_id == _jobs_mod.DEFAULT_JOB:
                 return self._default   # closed default re-forms in place
@@ -1573,9 +1674,11 @@ class Tracker:
             return {"ok": 0, "error": "nworkers must be >= 1"}
         elastic = bool(doc.get("elastic", self.elastic))
         retry = _jobs_mod.RETRY_AFTER_MS_DEFAULT
+        self._reap_orphans()   # free capacity held by pre-crash jobs
         with self._lock:
             job = self._jobs.get(job_id)
             if job is not None and job.open:
+                self.submit_admitted_total += 1
                 return {"ok": 1, "job": job_id, "already": 1}
             if self._max_fleet_ranks and n > self._max_fleet_ranks:
                 return {"ok": 0,
@@ -1584,6 +1687,7 @@ class Tracker:
                                  f"{self._max_fleet_ranks}"}
             if self._fits_locked(n):
                 self._open_job_locked(job_id, n, elastic)
+                self.submit_admitted_total += 1
                 return {"ok": 1, "job": job_id}
             pos = self._admission.offer(
                 {"job": job_id, "nworkers": n, "elastic": elastic})
@@ -1616,6 +1720,7 @@ class Tracker:
         self._wal("job_open", job=job_id, nworkers=int(nworkers),
                   elastic=bool(elastic))
         self._jobs[job_id] = job
+        self._job_contact[job_id] = time.monotonic()
         return job
 
     def _close_job_locked(self, job, reason: str) -> None:
@@ -1638,6 +1743,54 @@ class Tracker:
                                   head["elastic"])
             admitted.append(head["job"])
         return admitted
+
+    def _reap_orphans(self) -> List[str]:
+        """Close open jobs no live worker is behind, freeing their
+        admission slots. Two kinds of dead weight:
+
+        * **WAL orphans** — a crash-resume re-adopts every
+          journaled-open job, but its workers may have died with the
+          old leader. Any wire contact tagged with the job clears it
+          from the orphan set; once the resume grace window has
+          passed, whatever remains is closed (``"orphaned"``).
+        * **Ghost jobs** — admitted from the FIFO queue after the
+          submitter stopped waiting (or flooded in by a submit
+          storm), so nobody ever registers. With
+          ``rabit_job_forming_timeout_ms`` set, an open job with no
+          registered rank, no pending registration, and no wire
+          contact for that long is closed (``"forming timeout"``).
+
+        Returns the reaped job ids; queued submissions are admitted
+        into the freed capacity."""
+        reaped: List[tuple] = []
+        admitted: List[str] = []
+        with self._lock:
+            if self._orphan_jobs and not self.in_resume_grace():
+                for jid in sorted(self._orphan_jobs):
+                    jb = self._jobs.get(jid)
+                    if jb is not None and jb.open:
+                        self._close_job_locked(jb, "orphaned")
+                        reaped.append((jid, "no contact since resume"))
+                self._orphan_jobs.clear()
+            t_ms = forming_timeout_ms()
+            if t_ms:
+                now = time.monotonic()
+                for jid, jb in list(self._jobs.items()):
+                    if (jid != _jobs_mod.DEFAULT_JOB and jb.open
+                            and not jb._ranks and not jb._pending
+                            and now - self._job_contact.get(jid, now)
+                            > t_ms / 1e3):
+                        self._close_job_locked(jb, "forming timeout")
+                        reaped.append((jid, f"forming > {t_ms} ms"))
+            if reaped:
+                admitted = self._admit_queued_locked()
+        for jid, why in reaped:
+            print(f"[tracker] reaped orphaned job {jid} ({why})",
+                  file=sys.stderr, flush=True)
+        for jid in admitted:
+            print(f"[tracker] admitted queued job {jid}",
+                  file=sys.stderr, flush=True)
+        return [jid for jid, _ in reaped]
 
     def _job_complete(self, job) -> None:
         """Every live rank of ``job`` sent shutdown: close its world,

@@ -91,6 +91,16 @@ echo "== tier 0m: wire-quantization smoke (encode -> decode -> elect) =="
 # fabric and declines on a fast one — pure host-side, no device mesh
 JAX_PLATFORMS=cpu python -m rabit_tpu.parallel.wire --smoke
 
+echo "== tier 0n: SLO plane + mini-soak (burn math -> chaos -> gate) =="
+# the SLO evaluator's own smoke (histogram quantiles, burn states,
+# family registration), then a ~60 s mini-soak: one leader+standby
+# tracker pair behind the chaos proxy, a rolling handful of real jobs
+# through admission, every chaos scenario live (incl. a tracker_kill
+# -> promotion), asserting a well-formed soak/v1 artifact with all
+# four fleet SLOs evaluated and the gate computed
+python -m rabit_tpu.telemetry.slo --smoke
+python tools/soak.py --smoke --quiet > /tmp/rabit_soak_smoke.json
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

@@ -1,0 +1,350 @@
+"""SLO plane + soak harness (ISSUE 17): burn math and histogram
+quantiles, objective overrides and env knobs, gauge families and
+exposition, failover stamping at standby promotion, the tracker's
+``/slo`` route and shed-rate verdicts, soak/v1 history ingestion with
+per-metric direction registration, the trace_report soak renderer,
+the T004 scenario-registration lint rule, and the end-to-end proof
+that ``tools/soak.py`` exits nonzero on an injected SLO violation."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from rabit_tpu.telemetry import history, prom, slo
+from rabit_tpu.tracker.standby import StandbyTracker
+from rabit_tpu.tracker.tracker import Tracker
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SOAK = os.path.join(ROOT, "tools", "soak.py")
+SHORT = 300      # lease short enough that a test can wait out expiry
+
+
+# ------------------------------------------------------------- burn math
+
+def test_hist_quantile_is_pow2_upper_bound():
+    # bucket k covers (2^(k-1), 2^k] µs; the quantile answers with the
+    # smallest bucket top whose cumulative count reaches q
+    assert slo.hist_quantile_us({0: 50, 5: 30, 10: 19, 14: 1}) == 1024.0
+    assert slo.hist_quantile_us({3: 1}) == 8.0
+    assert slo.hist_quantile_us({}, 0.99) is None
+
+
+def test_p99_from_recorder_counters_merges_collectives():
+    counters = [
+        {"name": "allreduce", "hist_log2_us": {5: 99}},
+        {"name": "reduce_scatter", "hist_log2_us": {12: 1}},
+        {"name": "compile", "hist_log2_us": {20: 5}},  # not a collective
+    ]
+    # 100 samples, 99 at 32 µs, 1 at 4096 µs: the 99th sample lands in
+    # the 32 µs bucket, so p99 is its upper bound — and the compile row
+    # must never contribute
+    assert slo.p99_ms_from_counters(counters) == pytest.approx(0.032)
+    assert slo.p99_ms_from_counters([]) is None
+    assert slo.p99_ms_from_counters(
+        counters[1:]) == pytest.approx(4.096)
+
+
+def test_burn_ratio_directions():
+    p99 = [s for s in slo.default_slos() if s.name == "p99_ms"][0]
+    avail = [s for s in slo.default_slos() if s.name == "availability"][0]
+    assert slo.burn_ratio(p99, 1000.0) == pytest.approx(0.5)
+    # higher-is-better fraction burns on the error budget (1 - value)
+    assert slo.burn_ratio(avail, 0.95) == pytest.approx(0.5)
+    assert slo.burn_ratio(avail, 1.0) == 0.0
+
+
+def test_evaluate_states_and_worst():
+    slos = slo.default_slos(overrides={"p99_ms": 100.0})
+    v = slo.evaluate_all(slos, {"p99_ms": 250.0})
+    states = {x["slo"]: x["state"] for x in v}
+    assert states["p99_ms"] == slo.VIOLATING
+    assert states["availability"] == slo.NO_DATA
+    assert slo.worst_state(v) == slo.VIOLATING
+    ok = slo.evaluate_all(slos, {"p99_ms": 10.0})
+    assert {x["state"] for x in ok} == {slo.OK, slo.NO_DATA}
+
+
+def test_env_knob_sets_objective(monkeypatch):
+    monkeypatch.setenv("RABIT_SLO_P99_MS", "123")
+    p99 = [s for s in slo.default_slos() if s.name == "p99_ms"][0]
+    assert p99.objective == 123.0
+    # explicit overrides beat env
+    p99 = [s for s in slo.default_slos(overrides={"p99_ms": 7.0})
+           if s.name == "p99_ms"][0]
+    assert p99.objective == 7.0
+
+
+def test_gauge_families_registered_and_render():
+    v = slo.evaluate_all(slo.default_slos(), {"p99_ms": 10.0})
+    specs = slo.gauges(v)
+    for name, _help, _typ, _rows in specs:
+        assert name in prom.METRIC_FAMILIES
+    text = prom.render_prometheus([], gauges=specs)
+    assert 'rabit_slo_state{slo="p99_ms"} 0' in text
+    assert 'rabit_slo_state{slo="failover_ms"} -1' in text
+    assert "rabit_failover_duration_ms" in prom.METRIC_FAMILIES
+
+
+# ------------------------------------------- failover stamps + /slo route
+
+def test_promotion_stamps_failover_duration(tmp_path):
+    leader = Tracker(1, wal_dir=str(tmp_path / "leader"),
+                     lease_ms=SHORT, node_id="lead")
+    leader.start()
+    sb = StandbyTracker(leader.host, leader.port, 1,
+                        wal_dir=str(tmp_path / "standby"),
+                        lease_ms=SHORT, node_id="sb", quiet=True).start()
+    try:
+        deadline = time.monotonic() + 5.0
+        # promotion is lease-gated: wait for a replicated lease before
+        # killing the leader
+        while sb._lease is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sb._lease is not None
+        leader.crash()
+        deadline = time.monotonic() + 10.0
+        while not sb.promoted() and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert sb.promoted()
+        tr = sb.tracker
+        assert tr.promoted_wall > 0
+        assert tr.promoted_mono > 0
+        # detected via lease expiry: the span covers at least one lease
+        assert tr.failover_duration_ms >= SHORT * 0.5
+        assert tr.failover_duration_ms < 30000
+        names = [g[0] for g in tr._live_gauges()]
+        assert "rabit_failover_duration_ms" in names
+        assert "rabit_slo_state" in names
+    finally:
+        sb.stop()
+        if not leader.crashed:
+            leader.stop()
+
+
+def test_tracker_slo_route_and_shed_rate(monkeypatch):
+    monkeypatch.setenv("RABIT_MULTI_JOB", "1")
+    tr = Tracker(2)
+    doc = tr._slo_doc()
+    states = {v["slo"]: v["state"] for v in doc["slos"]}
+    # a fresh tracker judges only what it can measure; both unmeasured
+    assert states == {"failover_ms": "no_data", "shed_rate": "no_data"}
+    assert doc["worst"] == "no_data"
+    # verdict tallies feed the shed-rate measurement
+    tr.submit_admitted_total = 7
+    tr._admission.queued_total = 2
+    tr._admission.shed_total = 1
+    doc = tr._slo_doc()
+    by = {v["slo"]: v for v in doc["slos"]}
+    assert by["shed_rate"]["value"] == pytest.approx(0.1)
+    assert by["shed_rate"]["state"] == "ok"
+
+
+def test_resume_reaps_orphan_jobs(tmp_path, monkeypatch):
+    """WAL-resumed jobs whose ranks never re-present must not hold
+    admission slots forever: after the resume grace window, a submit
+    reaps them and is admitted into the freed capacity."""
+    monkeypatch.setenv("RABIT_MULTI_JOB", "1")
+    monkeypatch.setenv("RABIT_MAX_JOBS", "2")
+    monkeypatch.setenv("RABIT_TRACKER_RESUME_GRACE_MS", "1")
+    wal = str(tmp_path / "wal")
+    first = Tracker(1, wal_dir=wal)
+    assert first._submit(json.dumps({"job": "a"}))["ok"] == 1
+    assert first._submit(json.dumps({"job": "b"}))["ok"] == 1
+    # fleet is at max_jobs: a third job sheds or queues, never admits
+    assert first._submit(json.dumps({"job": "c"}))["ok"] == 0
+    first._wal_log.close()   # simulate the crash (no job_close records)
+
+    second = Tracker(1, wal_dir=wal, resume=True)
+    assert second._orphan_jobs == {"a", "b"}
+    time.sleep(0.01)         # outlive the 1 ms grace window
+    # wire contact tagged with job "a" is proof of life: not an orphan
+    assert second._job_for("a") is not None
+    assert second._orphan_jobs == {"b"}
+    # the next submit reaps "b" and fits in the freed slot
+    assert second._submit(json.dumps({"job": "c"}))["ok"] == 1
+    assert not second._orphan_jobs
+    assert second._jobs["a"].open           # survived: contact seen
+    assert not second._jobs["b"].open       # reaped
+    assert second._jobs["b"].closed_reason == "orphaned"
+    second._wal_log.close()
+
+
+def test_forming_timeout_reaps_ghost_jobs(monkeypatch):
+    """A job admitted after its submitter stopped waiting has nobody
+    behind it: with rabit_job_forming_timeout_ms set, it is reaped and
+    the freed slot admits the next submission."""
+    monkeypatch.setenv("RABIT_MULTI_JOB", "1")
+    monkeypatch.setenv("RABIT_MAX_JOBS", "2")
+    monkeypatch.setenv("RABIT_JOB_FORMING_TIMEOUT_MS", "10")
+    tr = Tracker(1)
+    assert tr._submit(json.dumps({"job": "a"}))["ok"] == 1
+    assert tr._submit(json.dumps({"job": "b"}))["ok"] == 1
+    time.sleep(0.03)        # both exceed the 10 ms forming window
+    # wire contact refreshes "a"'s clock: it is live, not a ghost
+    assert tr._job_for("a") is not None
+    res = tr._submit(json.dumps({"job": "c"}))
+    assert res["ok"] == 1                    # "b" reaped, "c" fits
+    assert tr._jobs["a"].open
+    assert not tr._jobs["b"].open
+    assert tr._jobs["b"].closed_reason == "forming timeout"
+
+
+# --------------------------------------------------- history + rendering
+
+def _soak_doc(value_p99=50.0, smoke=False):
+    slos = slo.evaluate_all(slo.default_slos(), {
+        "availability": 0.99, "p99_ms": value_p99,
+        "failover_ms": 900.0, "shed_rate": 0.1})
+    return {"schema": "rabit_tpu.soak/v1",
+            "timestamp_utc": "20260806T000000Z",
+            "duration_s": 60, "qps_key": "2", "seed": 7,
+            "smoke": smoke, "slos": slos,
+            "rounds": {"total": 100, "on_time": 99},
+            "gate": {"pass": True, "violating": [], "no_data": []}}
+
+
+def test_history_ingests_soak_with_directions():
+    recs = history.records_from_artifact(_soak_doc(), source="t")
+    by = {r["metric"]: r for r in recs}
+    assert set(by) == {"soak_availability", "soak_p99_ms",
+                       "soak_failover_ms", "soak_shed_rate"}
+    assert by["soak_availability"]["direction"] == "higher"
+    assert by["soak_shed_rate"]["direction"] == "lower"
+    # smoke soaks are noise by design: no records
+    assert history.records_from_artifact(_soak_doc(smoke=True)) == []
+
+
+def test_history_fingerprint_ignores_measurements():
+    a, b = _soak_doc(value_p99=50.0), _soak_doc(value_p99=80.0)
+    assert history.config_fingerprint(a) == history.config_fingerprint(b)
+    c = _soak_doc()
+    c["qps_key"] = "4"
+    assert history.config_fingerprint(a) != history.config_fingerprint(c)
+
+
+def test_history_append_dedupes_soak(tmp_path):
+    path = str(tmp_path / "history.jsonl")
+    recs = history.records_from_artifact(_soak_doc(), source="t")
+    assert history.append(path, recs) == 4
+    assert history.append(path, recs) == 0
+
+
+def test_register_direction_validates():
+    with pytest.raises(ValueError):
+        history.register_direction("x", "sideways")
+
+
+def test_trace_report_renders_soak():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import trace_report
+    finally:
+        sys.path.pop(0)
+    doc = _soak_doc()
+    assert trace_report.recognized(doc)
+    out = trace_report.render(doc)
+    assert "Fleet soak" in out and "PASS" in out
+    assert "| availability |" in out and "failover_ms" in out
+    bad = _soak_doc(value_p99=1e9)
+    bad["gate"] = {"pass": False, "violating": ["p99_ms"], "no_data": []}
+    out = trace_report.render(bad)
+    assert "FAIL" in out and "**VIOLATING**" in out
+
+
+# ------------------------------------------------------------- lint T004
+
+def _analysis():
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import analysis
+    finally:
+        sys.path.pop(0)
+    return analysis
+
+
+def test_t004_clean_on_real_soak():
+    a = _analysis()
+    findings = [f for f in a.check_file(SOAK) if f[2] == "T004"]
+    assert findings == []
+
+
+def test_t004_flags_unregistered_kind(tmp_path):
+    a = _analysis()
+    from analysis.core import FileContext, REPO
+    from analysis.rules_telemetry import check_soak_scenarios
+    src = ('SCENARIOS = {"bad": {"kind": "tracker_kil", '
+           '"target": "tracker"}}\n')
+    ctx = FileContext(os.path.join(REPO, "tools", "soak.py"), src)
+    out = check_soak_scenarios(ctx)
+    assert len(out) == 1 and "tracker_kil" in out[0][3]
+
+
+def test_t003_covers_slo_module():
+    a = _analysis()
+    path = os.path.join(ROOT, "rabit_tpu", "telemetry", "slo.py")
+    assert [f for f in a.check_file(path) if f[2] == "T003"] == []
+
+
+def test_scenarios_map_to_registered_kinds():
+    # runtime counterpart of T004: the table itself must build valid
+    # chaos schedules for both planes
+    sys.path.insert(0, os.path.join(ROOT, "tools"))
+    try:
+        import soak
+    finally:
+        sys.path.pop(0)
+    from rabit_tpu.chaos.schedule import KINDS, Schedule, TARGETS
+    for name, spec in soak.SCENARIOS.items():
+        assert spec["kind"] in KINDS, name
+        assert spec["target"] in TARGETS, name
+    sched = Schedule.from_spec(soak.chaos_spec(60.0, 1))
+    kinds = {r.kind for r in sched.rules}
+    assert kinds == {soak.SCENARIOS[n]["kind"] for n in soak.SCENARIOS}
+    assert sched.for_target("tracker").rules
+    assert sched.for_target("link").rules
+
+
+# ------------------------------------------------- end-to-end gate proof
+
+def _run_soak(*extra):
+    return subprocess.run(
+        [sys.executable, SOAK, "--duration", "8", "--qps", "0.8",
+         "--quiet", "--no-history", *extra],
+        capture_output=True, text=True, timeout=120, cwd=ROOT)
+
+
+def test_soak_exits_nonzero_on_injected_violation(tmp_path):
+    # any measured p99 is >= 1 µs, so a 0.0001 ms objective must
+    # violate — the gate, not a crash, produces the nonzero exit
+    out = str(tmp_path / "soak.json")
+    r = _run_soak("--objective", "p99_ms=0.0001", "--out", out)
+    assert r.returncode == 1, r.stderr[-2000:]
+    doc = json.load(open(out))
+    assert doc["gate"]["pass"] is False
+    assert "p99_ms" in doc["gate"]["violating"]
+    by = {v["slo"]: v for v in doc["slos"]}
+    assert by["p99_ms"]["state"] == "violating"
+    assert by["p99_ms"]["burn"] >= 1.0
+
+
+@pytest.mark.slow
+def test_soak_smoke_passes_and_trends(tmp_path):
+    # the full mini-soak contract (tier 0n), plus history trending of
+    # a non-smoke artifact into a scratch history file
+    out = str(tmp_path / "soak.json")
+    hist = str(tmp_path / "history.jsonl")
+    r = subprocess.run(
+        [sys.executable, SOAK, "--smoke", "--duration", "20", "--qps",
+         "0.8", "--quiet", "--out", out],
+        capture_output=True, text=True, timeout=180, cwd=ROOT)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "soak smoke ok" in r.stderr
+    doc = json.load(open(out))
+    assert doc["gate"]["pass"] and len(doc["slos"]) == 4
+    doc["smoke"] = False
+    assert history.append(
+        hist, history.records_from_artifact(doc, source="t")) == 4

@@ -20,7 +20,7 @@ from .core import (  # noqa: F401 - public re-exports
 
 # rule modules register themselves via the @rule decorator on import
 from . import rules_style    # noqa: F401  E999 F401 W191 W291
-from . import rules_telemetry  # noqa: F401  T001 T002 T003
+from . import rules_telemetry  # noqa: F401  T001 T002 T003 T004
 from . import rules_repo     # noqa: F401  R001 R002 R003 R004
 from . import rules_docs     # noqa: F401  R005 R006
 from . import locks          # noqa: F401  C001 C002 C003

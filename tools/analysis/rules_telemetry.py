@@ -1,6 +1,6 @@
-"""Telemetry-contract rules (T001–T003), ported unchanged from the
-lint monolith: span presence on collective entry points, counter
-presence on escalation paths, and /metrics family registration."""
+"""Telemetry-contract rules (T001–T004): span presence on collective
+entry points, counter presence on escalation paths, /metrics family
+registration, and soak-scenario -> chaos-kind registration."""
 
 from __future__ import annotations
 
@@ -54,6 +54,7 @@ T003_SCAN = (
     os.path.join("rabit_tpu", "engine", "xla.py"),
     os.path.join("rabit_tpu", "engine", "native.py"),
     os.path.join("rabit_tpu", "telemetry", "skew.py"),
+    os.path.join("rabit_tpu", "telemetry", "slo.py"),
 )
 
 _T003_TYPES = {"counter", "gauge", "histogram"}
@@ -172,6 +173,92 @@ def _t003_minted_names(tree):
                     isinstance(third, ast.Constant) and \
                     third.value in _T003_TYPES:
                 out.append((head.value, node.lineno))
+    return out
+
+
+# T004: soak scenario tables. rel path -> name of the module-level
+# dict mapping scenario name -> {"kind": ..., "target": ...}.
+T004_SCENARIO_TABLES = {
+    os.path.join("tools", "soak.py"): "SCENARIOS",
+}
+
+
+def _t004_registered_kinds():
+    """KINDS / TARGETS tuples parsed from chaos/schedule.py's AST
+    (never imported — same discipline as the T003 registry)."""
+    path = os.path.join(REPO, "rabit_tpu", "chaos", "schedule.py")
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None, None
+    kinds = targets = None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for t in node.targets:
+            if not isinstance(t, ast.Name):
+                continue
+            if t.id in ("KINDS", "TARGETS") and \
+                    isinstance(node.value, (ast.Tuple, ast.List)):
+                vals = {e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)}
+                if t.id == "KINDS":
+                    kinds = vals
+                else:
+                    targets = vals
+    return kinds, targets
+
+
+@rule("T004", explain="""\
+Soak-scenario registration: every entry in a soak scenario table (the
+T004_SCENARIO_TABLES map — e.g. SCENARIOS in tools/soak.py) must name
+a chaos rule ``kind`` registered in rabit_tpu/chaos/schedule.py KINDS
+and a ``target`` in TARGETS. A renamed or misspelled kind would make
+the scenario a silent no-op — the soak would still pass its SLOs while
+injecting nothing.""")
+def check_soak_scenarios(ctx):
+    table_name = T004_SCENARIO_TABLES.get(ctx.rel)
+    if not table_name or ctx.tree is None:
+        return []
+    kinds, targets = _t004_registered_kinds()
+    if kinds is None:
+        return [(ctx.rel, 1, "T004",
+                 "cannot parse KINDS from rabit_tpu/chaos/schedule.py")]
+    out = []
+    table = None
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and \
+                any(isinstance(t, ast.Name) and t.id == table_name
+                    for t in node.targets) and \
+                isinstance(node.value, ast.Dict):
+            table = node.value
+            break
+    if table is None:
+        return [(ctx.rel, 1, "T004",
+                 f"expected scenario table '{table_name}' not found "
+                 "(update T004_SCENARIO_TABLES)")]
+    for key, val in zip(table.keys, table.values):
+        name = key.value if isinstance(key, ast.Constant) else "?"
+        if not isinstance(val, ast.Dict):
+            out.append((ctx.rel, val.lineno, "T004",
+                        f"scenario '{name}' is not a dict literal"))
+            continue
+        fields = {k.value: v.value
+                  for k, v in zip(val.keys, val.values)
+                  if isinstance(k, ast.Constant)
+                  and isinstance(v, ast.Constant)}
+        kind = fields.get("kind")
+        if kind not in kinds:
+            out.append((ctx.rel, val.lineno, "T004",
+                        f"scenario '{name}' kind {kind!r} is not a "
+                        "registered chaos rule kind "
+                        "(rabit_tpu/chaos/schedule.py KINDS)"))
+        if targets is not None and fields.get("target") not in targets:
+            out.append((ctx.rel, val.lineno, "T004",
+                        f"scenario '{name}' target "
+                        f"{fields.get('target')!r} not in TARGETS"))
     return out
 
 

@@ -212,6 +212,23 @@ def live_status(target):
             "queue_depth": len(jobsdoc.get("queue", [])),
             "queued_total": jobsdoc.get("queued_total", 0),
             "shed_total": jobsdoc.get("shed_total", 0)}
+    # /slo is the SLO-plane burn route (multi-job or lease-guarded
+    # trackers); plain trackers and rank endpoints lack it and the
+    # field stays absent — scrape health never depends on it
+    try:
+        with urllib.request.urlopen(base + "/slo", timeout=5.0) as r:
+            slodoc = json.load(r)
+    except (OSError, ValueError, urllib.error.URLError):
+        slodoc = None
+    if isinstance(slodoc, dict) and isinstance(slodoc.get("slos"), list):
+        doc["slo"] = {
+            "worst": slodoc.get("worst", "no_data"),
+            "objectives": {
+                v["slo"]: {"state": v.get("state"),
+                           "value": v.get("value"),
+                           "burn": v.get("burn")}
+                for v in slodoc["slos"]
+                if isinstance(v, dict) and v.get("slo")}}
     doc["ok"] = bool(health.get("ok")) and doc["exposition_ok"]
     return doc, doc["ok"]
 

@@ -273,8 +273,54 @@ def render_sentinel(doc):
          "better", "trend", "verdict"), rows)
 
 
+def render_soak(doc):
+    """soak/v1 verdict: the PERF.md SLO table — each fleet objective
+    with its measured value, burn ratio, and gate state, plus the
+    run's chaos and admission story in one line each."""
+    rows = []
+    for v in doc.get("slos", []):
+        val = v.get("value")
+        burn = v.get("burn")
+        state = v.get("state", "?")
+        rows.append((
+            v.get("slo", "?"),
+            "-" if val is None else f"{val:g} {v.get('unit', '')}".strip(),
+            f"{v.get('objective', 0):g} {v.get('unit', '')}".strip(),
+            v.get("direction", ""),
+            "-" if burn is None else f"{burn:g}",
+            "**VIOLATING**" if state == "violating" else state))
+    gate = doc.get("gate", {})
+    rounds = doc.get("rounds", {})
+    jobs = doc.get("jobs", {})
+    adm = doc.get("admission", {}).get("verdicts", {})
+    chaos = doc.get("chaos", {})
+    fo = doc.get("failover", {})
+    ev = {**chaos.get("tracker_events", {}), **chaos.get("link_events", {})}
+    title = (f"Fleet soak — {doc.get('duration_s', '?')}s at "
+             f"{doc.get('qps_key', '?')} submits/s, "
+             f"{'PASS' if gate.get('pass') else 'FAIL'} "
+             f"({doc.get('timestamp_utc', '')})")
+    out = title + "\n\n" + _md_table(
+        ("SLO", "measured", "objective", "better", "burn", "state"), rows)
+    out += (f"\n\nRounds: {rounds.get('on_time', 0)}/"
+            f"{rounds.get('total', 0)} on schedule "
+            f"(deadline {rounds.get('deadline_ms', '?')} ms, "
+            f"{rounds.get('retried', 0)} retried, "
+            f"{rounds.get('failed', 0)} failed); jobs "
+            f"{jobs.get('completed', 0)}/{jobs.get('submitted', 0)} "
+            f"completed")
+    out += ("\nAdmission verdicts: " + ", ".join(
+        f"{k}={adm[k]}" for k in sorted(adm)) if adm else "")
+    out += ("\nChaos injected: " + (", ".join(
+        f"{k}×{ev[k]}" for k in sorted(ev)) or "none"))
+    if fo.get("promoted"):
+        out += (f"\nFailover: standby {fo.get('node', '?')} promoted in "
+                f"{fo.get('duration_ms', 0):g} ms")
+    return out
+
+
 _KINDS = ("telemetry_summary", "telemetry_fleet", "telemetry_trace",
-          "flight_record", "bench_sentinel")
+          "flight_record", "bench_sentinel", "soak")
 
 
 def recognized(doc):
@@ -295,6 +341,8 @@ def render(doc):
         return render_flight(doc)
     if matches(doc, "bench_sentinel"):
         return render_sentinel(doc)
+    if matches(doc, "soak"):
+        return render_soak(doc)
     if doc.get("schema") in ("rabit_tpu.collective_sweep/v1",
                              "rabit_tpu.collective_sweep/v2"):
         return render_sweep(doc)
