@@ -95,19 +95,21 @@ def _soft_close(sock: Optional[socket.socket]) -> None:
 _WIRE_MAGIC = 0x52425401
 
 
-def run_job_storm(host: str, port: int, rule: Rule, seed: int) -> dict:
-    """Fire one ``job_storm``: open ``rule.burst`` rogue connections
-    against the tracker at ``host:port``. Even draws send a complete
-    ``submit`` for a job that should never be admitted (fresh bogus
-    name; a third of them carry garbage payloads) and collect the
-    verdict; odd draws send a half-open ``start`` preamble — a length
-    prefix promising more bytes than ever arrive — then vanish with an
-    RST (the crashed-launcher shape). Seeded: two storms with the same
-    ``(seed, rule)`` emit byte-identical traffic in the same order.
-    Returns a tally the chaos smoke and cluster tests assert on."""
-    rng = random.Random(seed * 1_000_003 + 17)
-    tally = {"opened": 0, "submits": 0, "half_open": 0, "errors": 0,
-             "verdicts": []}
+# job_storm concurrency (ISSUE 19): rogues are driven by a BOUNDED
+# worker pool, never a thread per connection — a burst of hundreds is
+# genuinely concurrent load, and the storm itself obeys the same
+# no-thread-explosion discipline the C10k tracker is being tested on
+_STORM_POOL_MAX = 16
+
+
+def _storm_rogue(host: str, port: int, seed: int, i: int,
+                 tally: dict, lock: threading.Lock) -> None:
+    """One rogue connection, index ``i`` of the burst. Its traffic is
+    drawn from a Random keyed ``(seed, i)`` — per-connection streams
+    stay byte-identical across runs no matter how the pool interleaves
+    them (the determinism contract, restated for concurrency)."""
+    rng = random.Random((seed * 1_000_003 + 17) * 2_654_435_761 + i)
+    job = f"storm-{seed % 997}-{i}"
 
     def _s(conn: socket.socket, text: str) -> None:
         b = text.encode()
@@ -122,43 +124,93 @@ def run_job_storm(host: str, port: int, rule: Rule, seed: int) -> dict:
             out += chunk
         return out
 
-    for i in range(rule.burst):
-        job = f"storm-{seed % 997}-{i}"
-        try:
-            conn = socket.create_connection(  # noqa: R001 - rogue client
-                (host, port), timeout=5.0)
-        except OSError:
+    time.sleep(rng.random() * 0.01)  # jittered arrival, still seeded
+    try:
+        conn = socket.create_connection(  # noqa: R001 - rogue client
+            (host, port), timeout=5.0)
+    except OSError:
+        with lock:
             tally["errors"] += 1
-            continue
-        tally["opened"] += 1
-        try:
-            conn.settimeout(5.0)
-            conn.sendall(struct.pack("<I", _WIRE_MAGIC))
-            if i % 2 == 0:
-                _s(conn, "submit")
-                _s(conn, job)
-                conn.sendall(struct.pack("<I", 0))  # num_attempt
-                if rng.random() < 0.34:
-                    _s(conn, "{not json")  # malformed: error verdict
-                else:
-                    _s(conn, json.dumps({
-                        "job": job, "elastic": False,
-                        "nworkers": rng.randrange(2, 64)}))
-                tally["submits"] += 1
-                n = struct.unpack("<I", _recv_exact(conn, 4))[0]
-                tally["verdicts"].append(
-                    json.loads(_recv_exact(conn, n).decode()))
+        return
+    verdict = None
+    err = False
+    try:
+        conn.settimeout(5.0)
+        conn.sendall(struct.pack("<I", _WIRE_MAGIC))
+        if i % 2 == 0:
+            _s(conn, "submit")
+            _s(conn, job)
+            conn.sendall(struct.pack("<I", 0))  # num_attempt
+            if rng.random() < 0.34:
+                _s(conn, "{not json")  # malformed: error verdict
             else:
-                _s(conn, "start")
-                partial = f"{job}/0".encode()
-                conn.sendall(struct.pack("<I", len(partial) + 64)
-                             + partial)  # promise bytes that never come
-                tally["half_open"] += 1
-        except (OSError, ValueError):
+                _s(conn, json.dumps({
+                    "job": job, "elastic": False,
+                    "nworkers": rng.randrange(2, 64)}))
+            n = struct.unpack("<I", _recv_exact(conn, 4))[0]
+            verdict = json.loads(_recv_exact(conn, n).decode())
+        else:
+            _s(conn, "start")
+            partial = f"{job}/0".encode()
+            conn.sendall(struct.pack("<I", len(partial) + 64)
+                         + partial)  # promise bytes that never come
+    except (OSError, ValueError):
+        err = True
+    finally:
+        _hard_close(conn)
+    with lock:
+        tally["opened"] += 1
+        if err:
             tally["errors"] += 1
-        finally:
-            _hard_close(conn)
-        time.sleep(rng.random() * 0.01)  # jittered pacing, still seeded
+        elif i % 2 == 0:
+            tally["submits"] += 1
+            tally["verdicts"].append((i, verdict))
+        else:
+            tally["half_open"] += 1
+
+
+def run_job_storm(host: str, port: int, rule: Rule, seed: int,
+                  pool: Optional[int] = None) -> dict:
+    """Fire one ``job_storm``: open ``rule.burst`` rogue connections
+    against the tracker at ``host:port``, CONCURRENTLY through a
+    bounded pool of ``min(burst, pool)`` worker threads (default
+    ``_STORM_POOL_MAX``) — a burst of hundreds lands as genuinely
+    simultaneous submits, the thundering-herd shape admission control
+    must shed without stalling live jobs. Even indices send a complete
+    ``submit`` for a job that should never be admitted (fresh bogus
+    name; a third carry garbage payloads) and collect the verdict; odd
+    indices send a half-open ``start`` preamble — a length prefix
+    promising more bytes than ever arrive — then vanish with an RST
+    (the crashed-launcher shape). Seeded per connection: rogue ``i``
+    draws from a Random keyed ``(seed, i)``, so two storms with the
+    same ``(seed, rule)`` emit identical per-connection traffic
+    regardless of pool interleaving. Returns a tally the chaos smoke
+    and cluster tests assert on."""
+    tally = {"opened": 0, "submits": 0, "half_open": 0, "errors": 0,
+             "verdicts": []}
+    lock = threading.Lock()
+    nthreads = min(rule.burst, _STORM_POOL_MAX if pool is None
+                   else max(1, pool))
+    pending = list(range(rule.burst))
+
+    def _drain() -> None:
+        while True:
+            with lock:
+                if not pending:
+                    return
+                i = pending.pop(0)
+            _storm_rogue(host, port, seed, i, tally, lock)
+
+    threads = [threading.Thread(target=_drain,
+                                name=f"chaos-storm-{t}", daemon=True)
+               for t in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # index order, not completion order: assertions on the verdict
+    # list must not depend on pool scheduling
+    tally["verdicts"] = [v for _i, v in sorted(tally["verdicts"])]
     return tally
 
 
@@ -222,6 +274,8 @@ class ChaosProxy:
         # per-firing job_storm tallies (appended under _lock; tests
         # poll this to know the burst finished)
         self.storm_results: List[dict] = []
+        self._storm_threads: List[threading.Thread] = []
+        self._storm_quiesce = threading.Event()
         self.accepted = 0
         self.refused = 0
         self.bytes_forwarded = 0
@@ -239,10 +293,23 @@ class ChaosProxy:
         # aimed at whatever upstream retarget() currently points at
         for idx, rule in enumerate(self.schedule.rules):
             if rule.kind == "job_storm":
-                threading.Thread(target=self._storm_loop,
-                                 args=(rule, idx), daemon=True,
-                                 name=f"{self.name}-storm-{idx}").start()
+                t = threading.Thread(target=self._storm_loop,
+                                     args=(rule, idx), daemon=True,
+                                     name=f"{self.name}-storm-{idx}")
+                t.start()
+                self._storm_threads.append(t)
         return self
+
+    def join_storms(self, timeout: float = 30.0) -> None:
+        """Wait (bounded) for in-flight ``job_storm`` firings so their
+        tallies land in :attr:`storm_results` before a harvest — a
+        short-lived world must not race the storm it survived. Storms
+        still waiting for their window are told to stand down rather
+        than waited on."""
+        self._storm_quiesce.set()
+        deadline = time.monotonic() + timeout
+        for t in self._storm_threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     def stop(self) -> None:
         self._done.set()
@@ -297,9 +364,11 @@ class ChaosProxy:
         one firing, hurl the burst at the current upstream, and record
         the tally in :attr:`storm_results`."""
         start = rule.window_s[0] if rule.window_s else 0.0
-        while self.elapsed() < start and not self._done.is_set():
+        while self.elapsed() < start and not self._done.is_set() \
+                and not self._storm_quiesce.is_set():
             time.sleep(min(0.02, max(0.001, start - self.elapsed())))
-        if self._done.is_set() or not self._in_window(rule):
+        if self._done.is_set() or self._storm_quiesce.is_set() \
+                or not self._in_window(rule):
             return
         if not Schedule.consume(rule):
             return

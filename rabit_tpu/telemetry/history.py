@@ -55,12 +55,23 @@ _DIRECTIONS: Dict[str, str] = {
     "soak_failover_ms": "lower",
     "soak_shed_rate": "lower",
 }
+# tracker_bench/v1 per-rung series at the standard idle-conn ladder
+# (ISSUE 19): throughput is higher-better; latency, resident threads
+# and descriptors gate on GROWTH. Seeded for the same reason as the
+# soak rows — a bare sentinel run must judge a committed artifact
+# correctly without importing the bench tool.
+for _lvl in (0, 1000, 5000, 10000):
+    _DIRECTIONS[f"tracker_regs_per_s.c{_lvl}"] = "higher"
+    _DIRECTIONS[f"tracker_cmd_p99_ms.c{_lvl}"] = "lower"
+    _DIRECTIONS[f"tracker_threads.c{_lvl}"] = "lower"
+    _DIRECTIONS[f"tracker_fds.c{_lvl}"] = "lower"
 # artifact keys that are measurements/noise, never configuration
 _NON_CONFIG_KEYS = frozenset({
     "value", "vs_baseline", "correct", "timestamp_utc", "t_dev_ms",
     "t_host_ms", "gbps", "bandwidth_vs_rows", "losses", "rows", "table",
     "counters", "spans", "tpu", "cpu", "status", "cached_from",
     "best_step_s", "compile_plus_first_step_s", "complete",
+    "bounded_threads", "max_idle_conns",
 })
 
 
@@ -165,6 +176,23 @@ def extract_metrics(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
             if v.get("direction") in ("lower", "higher"):
                 register_direction(metric, v["direction"])
             add(metric, v.get("value"), str(v.get("unit", "")))
+    if doc.get("schema") == "rabit_tpu.tracker_bench/v1" \
+            and not doc.get("smoke"):  # smoke ladders are noise by design
+        # one series per (measurement, idle-conn rung): a thread count
+        # that starts scaling with connections, an fd leak, or a p99
+        # blow-up at 10k idle conns fails CI like any perf regression
+        for lv in doc.get("levels", []):
+            if not isinstance(lv, dict) or "idle_conns" not in lv:
+                continue
+            rung = lv["idle_conns"]
+            for key, unit, direction in (
+                    ("regs_per_s", "regs/s", "higher"),
+                    ("cmd_p99_ms", "ms", "lower"),
+                    ("threads", "threads", "lower"),
+                    ("fds", "fds", "lower")):
+                metric = f"tracker_{key}.c{rung}"
+                register_direction(metric, direction)
+                add(metric, lv.get(key), unit)
     return out
 
 

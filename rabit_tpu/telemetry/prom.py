@@ -106,6 +106,11 @@ METRIC_FAMILIES = (
     "rabit_slo_value",
     "rabit_slo_burn_ratio",
     "rabit_failover_duration_ms",
+    # C10k event-loop control plane (tracker/tracker.py, ISSUE 19)
+    "rabit_tracker_open_conns",
+    "rabit_tracker_loop_lag_ms",
+    "rabit_wal_snapshot_seq",
+    "rabit_sched_preemptions_total",
 )
 
 

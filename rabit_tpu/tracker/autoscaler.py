@@ -1,6 +1,6 @@
-"""First autoscaler loop for the multi-job control plane (ISSUE 15):
-read the tracker's fleet metrics plane, drive the existing membership
-path.
+"""Fleet scheduler + autoscaler loop for the multi-job control plane
+(ISSUEs 15/19): read the tracker's fleet metrics plane, drive the
+existing membership path.
 
 The tracker already exposes everything a scheduler needs — per-job
 straggler verdicts on ``/straggler``, per-job health on ``/jobs`` —
@@ -25,6 +25,18 @@ Deliberately conservative:
 - every decision rides the public wire/HTTP planes, so the loop can
   run anywhere the operator can reach the tracker (it holds no
   tracker-internal state and is safe to kill at any time).
+
+ISSUE 19 adds the FLEET half: weighted cross-job fairness over
+``rabit_max_fleet_ranks``. Under contention (a non-empty admission
+queue) each open job is entitled to a weighted share of the fleet cap
+(:func:`fair_shares`, largest-remainder apportionment over
+``rabit_sched_weight``); an elastic job living beyond its share is
+shrunk — same strikes hysteresis, one rank per job per sweep, highest
+live rank first — until the queue can drain into the freed capacity.
+An UNCONTENDED fleet is work-conserving: nothing is shrunk just for
+exceeding a share nobody else wants. Priority-class preemption is the
+tracker's own, synchronous, half (a higher-class ``submit`` evicts
+lowest-class ranks inline); this loop is the slow rebalancing half.
 """
 
 from __future__ import annotations
@@ -80,6 +92,29 @@ def autoscale_min_world() -> int:
     return _int_env(MIN_WORLD_ENV, MIN_WORLD_DEFAULT, 1)
 
 
+def fair_shares(jobs: List[dict], cap: int) -> Dict[str, int]:
+    """Weighted largest-remainder apportionment of ``cap`` ranks
+    across open jobs: job ``j`` is entitled to
+    ``cap * weight_j / sum(weights)`` ranks, floored, with the
+    leftover ranks going to the largest fractional remainders
+    (job-id ties broken lexicographically, so shares are
+    deterministic). Inelastic jobs get a share too — they consume
+    capacity even though only elastic jobs can be shrunk toward
+    theirs."""
+    live = [(str(jd["job"]), float(jd.get("weight", 1.0)) or 1.0)
+            for jd in jobs if isinstance(jd, dict) and jd.get("job")]
+    total_w = sum(w for _, w in live)
+    if cap <= 0 or total_w <= 0:
+        return {}
+    exact = {j: cap * w / total_w for j, w in live}
+    shares = {j: int(exact[j]) for j, _ in live}
+    leftover = cap - sum(shares.values())
+    order = sorted(shares, key=lambda j: (-(exact[j] - shares[j]), j))
+    for j in order[:leftover]:
+        shares[j] += 1
+    return shares
+
+
 def request_evict(host: str, port: int, rank: int, reason: str,
                   job_id: str = _jobs_mod.DEFAULT_JOB,
                   timeout: float = 5.0) -> bool:
@@ -126,7 +161,9 @@ class Autoscaler:
         self.strikes_needed = autoscale_strikes()
         self.min_world = autoscale_min_world()
         self._strikes: Dict[Tuple[str, int], int] = {}
+        self._fleet_strikes: Dict[str, int] = {}
         self.evicted_total = 0
+        self.rebalanced_total = 0
         self.sweeps = 0
         self._stop = threading.Event()
 
@@ -196,12 +233,57 @@ class Autoscaler:
                 del self._strikes[key]
         return actions
 
+    def fleet_sweep(self) -> List[Tuple[str, int]]:
+        """One fairness pass (ISSUE 19): under contention (submissions
+        waiting in the admission queue), shrink elastic jobs living
+        beyond their weighted share of ``rabit_max_fleet_ranks`` —
+        highest live rank first, one rank per job per sweep, same
+        strikes hysteresis as the straggler policy. Uncontended (or
+        uncapped), the fleet is work-conserving and this is a no-op.
+        Returns the (job, rank) evictions performed."""
+        doc = self._scrape("/jobs") or {}
+        cap = int(doc.get("max_fleet_ranks", 0) or 0)
+        contended = bool(doc.get("queue"))
+        open_jobs = [jd for jd in doc.get("jobs", [])
+                     if isinstance(jd, dict) and jd.get("job")
+                     and jd.get("status") != "closed"]
+        if not cap or not contended or not open_jobs:
+            self._fleet_strikes.clear()
+            return []
+        shares = fair_shares(open_jobs, cap)
+        actions: List[Tuple[str, int]] = []
+        for jd in open_jobs:
+            job_id = str(jd["job"])
+            live_ranks = [int(r) for r in (jd.get("live") or [])]
+            world = len(live_ranks) or int(jd.get("world", 0) or 0)
+            share = shares.get(job_id, 0)
+            if not jd.get("elastic") \
+                    or world <= max(share, self.min_world):
+                self._fleet_strikes.pop(job_id, None)
+                continue
+            n = self._fleet_strikes.get(job_id, 0) + 1
+            self._fleet_strikes[job_id] = n
+            if n < self.strikes_needed:
+                continue
+            rank = max(live_ranks) if live_ranks else world - 1
+            reason = (f"fleet rebalance: world {world} over weighted "
+                      f"share {share} with submissions queued")
+            if self._evict(job_id, rank, reason):
+                self.rebalanced_total += 1  # noqa: C003 - sole writer
+                actions.append((job_id, rank))
+                self._fleet_strikes.pop(job_id, None)
+                print(f"[autoscaler] rebalanced job {job_id}: evicted "
+                      f"rank {rank} ({reason})", file=sys.stderr,
+                      flush=True)
+        return actions
+
     # -- loop -------------------------------------------------------------
     def run(self) -> None:
         period = autoscale_interval_ms() / 1e3
         while not self._stop.wait(period):
             try:
                 self.sweep()
+                self.fleet_sweep()
             except Exception as e:  # noqa: BLE001 - loop must survive
                 print(f"[autoscaler] sweep failed: {e}",
                       file=sys.stderr, flush=True)
@@ -267,6 +349,78 @@ def _smoke() -> None:
             {"job": "jobB", "world": 4, "elastic": False}]}
         assert sc.sweep() == [] and sc.sweep() == []
         assert sc.evicted_total == 1
+
+        # -- fleet fairness (ISSUE 19) --------------------------------
+        # weighted shares: cap 8 split 1:3 -> jobA 2, jobB 6
+        assert fair_shares([{"job": "jobA", "weight": 1.0},
+                            {"job": "jobB", "weight": 3.0}], 8) \
+            == {"jobA": 2, "jobB": 6}
+        # remainders go to the largest fraction, ties lexicographic
+        assert fair_shares([{"job": "a"}, {"job": "b"},
+                            {"job": "c"}], 8) \
+            == {"a": 3, "b": 3, "c": 2}
+        # contended fleet (queue non-empty): jobA is 2 ranks over its
+        # share -> strikes accrue, then its HIGHEST live rank goes;
+        # jobB sits under its share and is untouched
+        evicted.clear()
+        state["jobs"] = {"max_fleet_ranks": 8, "queue": [{"job": "jobC"}],
+                        "jobs": [
+            {"job": "jobA", "world": 4, "elastic": True, "weight": 1.0,
+             "status": "live", "live": [0, 1, 2, 3]},
+            {"job": "jobB", "world": 4, "elastic": True, "weight": 3.0,
+             "status": "live", "live": [0, 1, 2, 3]}]}
+        assert sc.fleet_sweep() == []    # strike 1 of 2: hysteresis
+        assert sc.fleet_sweep() == [("jobA", 3)]
+        assert evicted == [("jobA", 3)] and sc.rebalanced_total == 1
+        # uncontended (queue empty): over-share is fine, strikes clear
+        state["jobs"]["queue"] = []
+        assert sc.fleet_sweep() == [] and sc._fleet_strikes == {}
+        # at the min_world floor the fleet sweep also refuses
+        state["jobs"] = {"max_fleet_ranks": 4, "queue": [{"job": "jobC"}],
+                        "jobs": [
+            {"job": "jobA", "world": 2, "elastic": True, "weight": 1.0,
+             "status": "live", "live": [0, 1]},
+            {"job": "jobB", "world": 2, "elastic": True, "weight": 9.0,
+             "status": "live", "live": [0, 1]}]}
+        assert sc.fleet_sweep() == [] and sc.fleet_sweep() == []
+
+        # -- priority preemption (tracker-side, ISSUE 19) -------------
+        # a higher-class submit against a full fleet evicts the lowest
+        # class's ranks via the elastic evict path and is admitted
+        from .tracker import Tracker
+        env2 = {k: os.environ.get(k) for k in
+                (_jobs_mod.MULTI_JOB_ENV, _jobs_mod.MAX_FLEET_RANKS_ENV)}
+        os.environ[_jobs_mod.MULTI_JOB_ENV] = "1"
+        os.environ[_jobs_mod.MAX_FLEET_RANKS_ENV] = "4"
+        try:
+            tr = Tracker(2, elastic=True).start()
+            try:
+                assert _jobs_mod.submit(
+                    tr.host, tr.port, "low", 4, elastic=True)["ok"] == 1
+                conns = [_jobs_mod.wire_register(tr.host, tr.port,
+                                                 f"low/{i}")
+                         for i in range(4)]
+                for c in conns:
+                    _jobs_mod.wire_read_assignment(c)
+                v = _jobs_mod.submit(tr.host, tr.port, "hi", 2,
+                                     elastic=True, sched_class=2)
+                assert v.get("ok") == 1 and v.get("preempted") == 2, v
+                low = tr.job("low")
+                assert low.quota == 2 and sorted(
+                    low._member.live) == [0, 1]
+                assert tr.sched_preemptions == {0: 2}
+                # an equal-class submit must NOT preempt: it queues
+                v = _jobs_mod.submit(tr.host, tr.port, "peer", 2,
+                                     elastic=True)
+                assert not v.get("ok") and v.get("queued") == 1, v
+            finally:
+                tr.stop()
+        finally:
+            for k, val in env2.items():
+                if val is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = val
         print("autoscaler smoke ok")
     finally:
         for k in (STRIKES_ENV, LAG_ENV, MIN_WORLD_ENV):

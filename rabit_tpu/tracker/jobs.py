@@ -50,11 +50,15 @@ MULTI_JOB_ENV = "RABIT_MULTI_JOB"
 MAX_JOBS_ENV = "RABIT_MAX_JOBS"
 MAX_FLEET_RANKS_ENV = "RABIT_MAX_FLEET_RANKS"
 ADMISSION_QUEUE_ENV = "RABIT_ADMISSION_QUEUE"
+SCHED_CLASS_ENV = "RABIT_SCHED_CLASS"
+SCHED_WEIGHT_ENV = "RABIT_SCHED_WEIGHT"
 
 MAX_JOBS_DEFAULT = 8
 MAX_FLEET_RANKS_DEFAULT = 0        # 0 = unbounded
 ADMISSION_QUEUE_DEFAULT = 4
 RETRY_AFTER_MS_DEFAULT = 500
+SCHED_CLASS_DEFAULT = 0
+SCHED_WEIGHT_DEFAULT = 1.0
 
 # job lifecycle: forming (submitted/opened, world not yet assembled)
 # -> live (first epoch formed) -> closed (all ranks shut down, or the
@@ -105,6 +109,33 @@ def admission_queue_depth() -> int:
         return ADMISSION_QUEUE_DEFAULT
 
 
+def sched_class() -> int:
+    """``rabit_sched_class``: the priority class a ``submit`` carries
+    (higher = more important, default 0). Under
+    ``rabit_max_fleet_ranks`` contention, a higher-class submit may
+    preempt ranks from the lowest open class (elastic jobs only) via
+    the tracker's fleet scheduler (ISSUE 19); equal or lower classes
+    queue FIFO as before."""
+    try:
+        return max(0, int(os.environ.get(SCHED_CLASS_ENV,
+                                         SCHED_CLASS_DEFAULT)))
+    except ValueError:
+        return SCHED_CLASS_DEFAULT
+
+
+def sched_weight() -> float:
+    """``rabit_sched_weight``: this job's share weight in the fleet
+    scheduler's weighted fairness over ``rabit_max_fleet_ranks``
+    (default 1.0). A weight-2 job is entitled to twice the ranks of a
+    weight-1 neighbor when the autoscaler's fleet sweep rebalances a
+    contended fleet."""
+    try:
+        w = float(os.environ.get(SCHED_WEIGHT_ENV, SCHED_WEIGHT_DEFAULT))
+        return w if w > 0 else SCHED_WEIGHT_DEFAULT
+    except ValueError:
+        return SCHED_WEIGHT_DEFAULT
+
+
 def split_task(task_id: str) -> Tuple[str, str]:
     """``<job>/<task>`` -> ``(job, task)``; no separator -> the
     implicit default job. Only ever called when multi-job is ON — the
@@ -134,10 +165,20 @@ class JobState:
     poison a neighbor or the accept loop."""
 
     def __init__(self, job_id: str, nworkers: int,
-                 elastic: bool = False):
+                 elastic: bool = False, sched_class: int = 0,
+                 sched_weight: float = 1.0):
         self.job_id = str(job_id)
         self.nworkers = int(nworkers)
         self.elastic = bool(elastic)
+        # fleet scheduler (ISSUE 19): priority class (higher wins under
+        # contention), fairness weight, and the admission-counted rank
+        # quota — nworkers until preemption shrinks it, so with the
+        # scheduler knobs unset every capacity sum is exactly the old
+        # nworkers sum
+        self.sched_class = int(sched_class)
+        self.sched_weight = float(sched_weight)
+        self.quota = self.nworkers
+        self.preempted = 0             # ranks taken by higher classes
         self.status = "forming"
         self.quarantined = 0            # commands quarantined at the boundary
         self.closed_reason = ""
@@ -209,6 +250,15 @@ class JobState:
             "endpoints": len(self._endpoints),
             "shutdown": len(self._shutdown_ranks),
             "closed_reason": self.closed_reason,
+            "sched_class": self.sched_class,
+            "weight": self.sched_weight,
+            "quota": self.quota,
+            "preempted": self.preempted,
+            # the fleet sweep needs rank IDENTITY (evict targets), not
+            # just a count; fixed worlds are the contiguous range
+            "live": (sorted(self._member.live)
+                     if self.elastic and self._member is not None
+                     else list(range(self.nworkers))),
         }
 
 
@@ -260,19 +310,32 @@ class AdmissionQueue:
 
 
 def submit(host: str, port: int, job_id: str, nworkers: int,
-           elastic: bool = False, timeout: float = 10.0) -> dict:
+           elastic: bool = False, timeout: float = 10.0,
+           sched_class: Optional[int] = None,
+           weight: Optional[float] = None) -> dict:
     """Submit a job to a running tracker over the ``submit`` wire
     command. Returns the tracker's JSON verdict immediately:
     ``{"ok": 1, ...}`` admitted, ``{"ok": 0, "queued": 1,
     "retry_after_ms": n}`` parked FIFO, ``{"ok": 0, "shed": 1,
     "retry_after_ms": n}`` shed — the tracker never stalls a
-    submitter."""
+    submitter. ``sched_class``/``weight`` default to the
+    ``rabit_sched_class``/``rabit_sched_weight`` knobs and ride the
+    payload only when non-default, so an unconfigured submit is
+    byte-identical to the pre-scheduler wire."""
     import struct
 
     from ..utils import retry
     from .tracker import MAGIC, _recv_str, _send_str, _send_u32
-    payload = json.dumps({"job": str(job_id), "nworkers": int(nworkers),
-                          "elastic": bool(elastic)})
+    doc = {"job": str(job_id), "nworkers": int(nworkers),
+           "elastic": bool(elastic)}
+    _class_knob = globals()["sched_class"]  # param shadows the knob fn
+    cls = sched_class if sched_class is not None else _class_knob()
+    w = weight if weight is not None else sched_weight()
+    if cls:
+        doc["sched_class"] = int(cls)
+    if w != SCHED_WEIGHT_DEFAULT:
+        doc["weight"] = float(w)
+    payload = json.dumps(doc)
     with retry.connect_with_retry(host, int(port),
                                   timeout=timeout) as conn:
         conn.sendall(struct.pack("<I", MAGIC))

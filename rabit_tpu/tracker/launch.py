@@ -77,10 +77,22 @@ class _ChaosFarm:
             proxies.append(self.tracker_proxy)
             self.tracker_proxy = None
         events = 0
+        storm_submits = 0
+        storm_shed = 0
         for p in proxies:
+            # a short-lived world must not race a storm it survived:
+            # let in-flight firings land their tallies (bounded)
+            p.join_storms()
             events += len(p.events)
+            for tally in getattr(p, "storm_results", []):
+                storm_submits += tally.get("submits", 0)
+                storm_shed += sum(1 for v in tally.get("verdicts", [])
+                                  if isinstance(v, dict)
+                                  and not v.get("ok"))
             p.stop()
-        return {"proxies": len(proxies), "events": events}
+        return {"proxies": len(proxies), "events": events,
+                "storm_submits": storm_submits,
+                "storm_shed": storm_shed}
 
 
 class _TrackerSupervisor:

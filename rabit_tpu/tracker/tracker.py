@@ -116,15 +116,23 @@ import struct
 import sys
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from collections import deque
+from typing import Deque, Dict, List, Optional, Tuple
 
 from ..telemetry.aggregate import format_fleet_table, merge_summaries
+from . import evloop as _evloop
 from . import jobs as _jobs_mod
 from . import membership as _membership
 from . import wal as _wal_mod
 
 MAGIC = 0x52425401
 NO_RANK = 0xFFFFFFFF
+
+# a wire string longer than this is a protocol violation, not a
+# payload: the cap keeps a flipped length bit from growing one
+# connection's input buffer without bound (same figure as the WAL's
+# MAX_RECORD_BYTES — no tracker payload serializes to megabytes)
+_MAX_WIRE_STR = 16 << 20
 
 
 def _recv_all(conn: socket.socket, n: int) -> bytes:
@@ -154,6 +162,54 @@ def _send_str(conn, s: str) -> None:
     b = s.encode()
     _send_u32(conn, len(b))
     conn.sendall(b)
+
+
+# -- incremental wire parsing (ISSUE 19) --------------------------------
+# The event loop feeds these generators bytes as they arrive: a
+# generator yields how many bytes it needs next and returns the parsed
+# value. Same grammar as the blocking helpers above (which the CLIENT
+# side — jobs.py, autoscaler, launch — still uses); the tracker's
+# accept path no longer blocks a thread per in-flight command.
+
+
+def _p_u32():
+    return struct.unpack("<I", (yield 4))[0]
+
+
+def _p_str():
+    n = struct.unpack("<I", (yield 4))[0]
+    if n == 0:
+        return ""
+    if n > _MAX_WIRE_STR:
+        raise ConnectionError(f"wire string claims {n} bytes")
+    return (yield n).decode()
+
+
+def _parse_command():
+    """One full worker->tracker request: preamble (magic, cmd, task_id,
+    num_attempt) plus the command's own fields. Returns ``(cmd,
+    task_id, args)`` — ``args`` is the per-command field tuple — or
+    ``None`` on a bad magic (the connection is hung up on, exactly as
+    the blocking path did)."""
+    magic = yield from _p_u32()
+    if magic != MAGIC:
+        return None
+    cmd = yield from _p_str()
+    task_id = yield from _p_str()
+    yield from _p_u32()   # num_attempt (informational)
+    if cmd in ("start", "recover", "join"):
+        host = yield from _p_str()
+        port = yield from _p_u32()
+        flags = yield from _p_u32()
+        token = yield from _p_str()
+        return cmd, task_id, (host, port, flags, token)
+    if cmd in ("print", "metrics", "endpoint", "resume", "evict",
+               "submit"):
+        payload = yield from _p_str()
+        return cmd, task_id, (payload,)
+    # topo / skew / world / shutdown / repl (and unknown commands)
+    # carry no extra request fields
+    return cmd, task_id, ()
 
 
 def tree_neighbors(rank: int, world: int) -> Tuple[Optional[int], List[int]]:
@@ -242,6 +298,235 @@ def resume_grace_ms() -> int:
             f"got {v!r}")
 
 
+# -- WAL replay fold (ISSUEs 10/19) --------------------------------------
+# The per-record replay application lives at module level so it has
+# exactly TWO consumers sharing ONE implementation: Tracker._replay
+# (resume / standby promotion) and fold_records (snapshot compaction,
+# live and `wal.py --compact`). Compacted state that drifted from
+# replay semantics would be a forged history, so they are the same
+# code by construction. Lint R003 exempts the ``_replay*`` family —
+# these functions ARE the journal's read side.
+
+
+class _ReplayWorld:
+    """Duck-typed minimal tracker for offline replay folds: exactly
+    the attributes ``_replay_apply`` / ``snapshot_state`` touch, none
+    of the sockets or threads a real Tracker binds."""
+
+    def __init__(self, nworkers: int, elastic: bool):
+        self.nworkers = int(nworkers)
+        self.elastic = bool(elastic)
+        self.multi_job = False
+        self._jobs: Dict[str, _jobs_mod.JobState] = {
+            _jobs_mod.DEFAULT_JOB: _jobs_mod.JobState(
+                _jobs_mod.DEFAULT_JOB, nworkers, elastic=elastic)}
+        self._orphan_jobs: set = set()
+        self.restarts = 0
+        self.promoted_wall = 0.0
+        self.promoted_mono = 0.0
+        self.failover_duration_ms = 0.0
+        self._lease: Optional[dict] = None
+        self._journaled_lease: Optional[dict] = None
+
+
+def snapshot_state(world) -> dict:
+    """Serialize ``world``'s replay-reachable control-plane state as a
+    ``wal_snapshot/v1`` doc: exactly the state a full journal replay
+    reconstructs (job table, ranks, epochs, membership sets, quota,
+    topo/skew/endpoint docs, shutdown ranks, restarts, failover
+    stamps, the journaled lease) — deliberately nothing ephemeral
+    (pending registrations, sockets, services die with the process
+    either way). The caller holds the tracker lock for a live
+    ``world``."""
+    jobs: Dict[str, dict] = {}
+    for jid, jb in world._jobs.items():
+        jd: Dict[str, object] = {
+            "nworkers": jb.nworkers, "elastic": jb.elastic,
+            "sched_class": jb.sched_class, "weight": jb.sched_weight,
+            "quota": jb.quota, "preempted": jb.preempted,
+            "closed": not jb.open, "closed_reason": jb.closed_reason,
+            "ranks": dict(jb._ranks), "epoch": jb._epoch,
+            "topo": dict(jb._topo), "skew": dict(jb._skew),
+            "endpoints": {t: dict(d)
+                          for t, d in jb._endpoints.items()},
+            "down": sorted(jb._shutdown_ranks)}
+        if jb.elastic and jb._member is not None:
+            mv = jb._member
+            jd["member"] = {
+                "target": mv.target, "live": sorted(mv.live),
+                "evicted": sorted(mv.evicted),
+                "joining": sorted(mv.joining),
+                "generation": mv.generation,
+                "evictions": mv.evictions,
+                "admissions": mv.admissions}
+        jobs[jid] = jd
+    doc: Dict[str, object] = {"multi_job": bool(world.multi_job),
+                              "restarts": int(world.restarts),
+                              "jobs": jobs}
+    if world.promoted_wall or world.failover_duration_ms:
+        doc["promoted"] = {
+            "wall": world.promoted_wall, "mono": world.promoted_mono,
+            "failover_ms": world.failover_duration_ms}
+    if world._journaled_lease is not None:
+        doc["lease"] = dict(world._journaled_lease)
+    return doc
+
+
+def _replay_adopt_into(world, state: dict) -> None:
+    """Adopt one ``wal_snapshot/v1`` state doc: REPLACES the job table
+    and journaled misc state; the journal's tail records then replay
+    on top. The implicit default job is mutated in place — its shape
+    (nworkers/elastic) comes from the launch, exactly as a full replay
+    never changes it — while every other job is rebuilt from its
+    snapshotted open-time shape."""
+    from ..telemetry import skew as _skew_mod
+    if state.get("multi_job"):
+        world.multi_job = True
+    world.restarts = int(state.get("restarts", world.restarts))
+    prom = state.get("promoted") or {}
+    if prom:
+        world.promoted_wall = float(prom.get("wall", 0.0))
+        world.promoted_mono = float(prom.get("mono", 0.0))
+        world.failover_duration_ms = float(prom.get("failover_ms", 0.0))
+    lease = state.get("lease")
+    if lease is not None:
+        world._lease = dict(lease)
+        world._journaled_lease = dict(lease)
+    keep = {_jobs_mod.DEFAULT_JOB:
+            world._jobs[_jobs_mod.DEFAULT_JOB]}
+    world._orphan_jobs.clear()
+    for jid, jd in (state.get("jobs") or {}).items():
+        jid = str(jid)
+        job = keep.get(jid)
+        if job is None:
+            job = _jobs_mod.JobState(
+                jid, int(jd.get("nworkers", world.nworkers)),
+                elastic=bool(jd.get("elastic", False)),
+                sched_class=int(jd.get("sched_class", 0)),
+                sched_weight=float(jd.get("weight", 1.0)))
+            keep[jid] = job
+        job.quota = int(jd.get("quota", job.nworkers))
+        job.preempted = int(jd.get("preempted", 0))
+        job._ranks = {str(t): int(r)
+                      for t, r in (jd.get("ranks") or {}).items()}
+        job._epoch = int(jd.get("epoch", 0))
+        job._topo = dict(jd.get("topo") or {})
+        digest = dict(jd.get("skew") or {})
+        if digest:
+            job._skew = digest
+            job._skew_election = _skew_mod.FleetElection.seeded(digest)
+        job._endpoints = {str(t): dict(d) for t, d in
+                          (jd.get("endpoints") or {}).items()}
+        job._shutdown_ranks = {int(r) for r in jd.get("down") or []}
+        m = jd.get("member")
+        if job.elastic and job._member is not None and m:
+            mv = job._member
+            mv.target = int(m.get("target", job.nworkers))
+            mv.live = {int(r) for r in m.get("live") or []}
+            mv.evicted = {int(r) for r in m.get("evicted") or []}
+            mv.joining = {int(r) for r in m.get("joining") or []}
+            mv.generation = int(m.get("generation", 0))
+            mv.evictions = int(m.get("evictions", 0))
+            mv.admissions = int(m.get("admissions", 0))
+        if jd.get("closed"):
+            job.close(str(jd.get("closed_reason", "")))
+        if jid != _jobs_mod.DEFAULT_JOB and job.open:
+            world._orphan_jobs.add(jid)
+    world._jobs = keep
+
+
+def _replay_apply(world, kind: str, data: dict) -> None:
+    """Apply ONE journaled ``(kind, data)`` record to ``world`` — a
+    Tracker mid-construction or a :class:`_ReplayWorld`. Raw mutations
+    are deliberate: this IS the WAL API's read side."""
+    from ..telemetry import skew as _skew_mod
+    if kind == _wal_mod.SNAPSHOT_KIND:
+        _replay_adopt_into(world, data.get("state") or {})
+        return
+    jid = str(data.get("job", _jobs_mod.DEFAULT_JOB))
+    if kind == "job_open":
+        # a journaled open proves multi-job was on when written
+        world.multi_job = True
+        prev = world._jobs.get(jid)
+        if prev is None or not prev.open:
+            world._jobs[jid] = _jobs_mod.JobState(
+                jid, int(data.get("nworkers", world.nworkers)),
+                elastic=bool(data.get("elastic", False)),
+                sched_class=int(data.get("sched_class", 0)),
+                sched_weight=float(data.get("weight", 1.0)))
+            if jid != _jobs_mod.DEFAULT_JOB:
+                world._orphan_jobs.add(jid)
+        return
+    if kind == "job_close":
+        closing = world._jobs.get(jid)
+        if closing is not None:
+            closing.close(str(data.get("reason", "")))
+        world._orphan_jobs.discard(jid)
+        return
+    job = world._jobs.get(jid)
+    if job is None:
+        # tagged records outlived a torn job_open: the tags
+        # themselves prove the job existed — adopt it
+        world.multi_job = True
+        job = _jobs_mod.JobState(jid, world.nworkers,
+                                 elastic=world.elastic)
+        world._jobs[jid] = job
+        if jid != _jobs_mod.DEFAULT_JOB:
+            world._orphan_jobs.add(jid)
+    if kind == "assign":
+        job._ranks[str(data["task"])] = int(data["rank"])
+    elif kind == "epoch":
+        job._epoch = int(data["epoch"])
+        if job.elastic and job._member is not None:
+            job._member.formed(data.get("members", []))
+    elif kind == "park":
+        if job.elastic and job._member is not None:
+            job._member.park(int(data["rank"]))
+    elif kind == "evict":
+        if job.elastic and job._member is not None:
+            job._member.evict(int(data["rank"]))
+    elif kind == "quota":
+        # a preemption's capacity transfer survives a resume:
+        # without this the victim would re-claim its full
+        # nworkers and over-commit the fleet cap
+        job.quota = int(data.get("quota", job.quota))
+        job.preempted = int(data.get("preempted", job.preempted))
+    elif kind == "topo":
+        job._topo = dict(data.get("doc") or {})
+    elif kind == "skew":
+        digest = dict(data.get("digest") or {})
+        job._skew = digest
+        job._skew_election = _skew_mod.FleetElection.seeded(digest)
+    elif kind == "endpoint":
+        job._endpoints[str(data["task"])] = dict(data["doc"])
+    elif kind == "down":
+        job._shutdown_ranks.add(int(data["rank"]))
+    elif kind == "resume":
+        world.restarts = int(data.get("restarts", world.restarts))
+    elif kind == "promoted":
+        # a journaled failover outlives the promoted process: a
+        # later resume keeps reporting the measured duration
+        world.promoted_wall = float(data.get("wall", 0.0))
+        world.promoted_mono = float(data.get("mono", 0.0))
+        world.failover_duration_ms = float(data.get("failover_ms", 0.0))
+    elif kind == _wal_mod.LEASE_KIND:
+        world._lease = dict(data)
+        world._journaled_lease = dict(data)
+
+
+def fold_records(records, nworkers: int = 1,
+                 elastic: bool = False) -> dict:
+    """Fold a replayed ``(kind, data)`` list into one
+    ``wal_snapshot/v1`` state doc — the offline half of snapshot
+    compaction (``wal.py --compact``). ``nworkers``/``elastic`` must
+    match the tracker launch shape, the same requirement ``--resume``
+    itself has."""
+    world = _ReplayWorld(nworkers, elastic)
+    for kind, data in records:
+        _replay_apply(world, kind, data)
+    return snapshot_state(world)
+
+
 def forming_timeout_ms() -> int:
     """``rabit_job_forming_timeout_ms`` (doc/parameters.md): close an
     open multi-job that has held an admission slot this long with no
@@ -318,12 +603,26 @@ class Tracker:
         # last wire contact per job (monotonic, stamped at open):
         # feeds the forming-timeout ghost-job reaper
         self._job_contact: Dict[str, float] = {}       # fleet-global
+        # fleet scheduler (ISSUE 19): recent job-close timestamps feed
+        # the measured-drain-rate retry_after_ms hint; preemptions are
+        # tallied per VICTIM class for the prom exposition
+        self._drain_t: Deque[float] = deque(maxlen=16)  # fleet-global
+        self.sched_preemptions: Dict[int, int] = {}    # fleet-global
         self.sock = socket.socket(socket.AF_INET,  # fleet-global: listener
                                   socket.SOCK_STREAM)
         self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self.sock.bind((host, port))
         self.sock.listen(256)
         self.host, self.port = self.sock.getsockname()  # fleet-global: addr
+        # C10k connection plane (ISSUE 19): ONE selectors event loop
+        # owns accept + read + write readiness for every worker
+        # connection (tracker/evloop.py); parsed commands flow through
+        # per-job FIFO queues into a FIXED service-thread pool. Idle
+        # connections cost a file descriptor, not a thread — resident
+        # thread count is bounded regardless of connection count.
+        self._loop = _evloop.EventLoop()    # fleet-global: readiness loop
+        self._svc = _evloop.ServicePool(    # fleet-global: command pool
+            name="rabit-tracker-svc")
         self._lock = threading.Lock()       # fleet-global: the tracker lock
         self._cv = threading.Condition(self._lock)  # fleet-global: batch cv
         self._done = threading.Event()      # fleet-global: lifecycle
@@ -408,7 +707,13 @@ class Tracker:
         # under it in several paths): frames live under their own
         # condition, appended by ``_wal`` and drained per-subscriber
         self._repl_cv = threading.Condition()   # fleet-global: repl plane
-        self._repl_log: List[bytes] = []    # fleet-global: frame i = seq i+1
+        # frame i carries seq _repl_base + i + 1. The base is CONSTANT
+        # per process: a live compaction APPENDS its snapshot frame to
+        # this in-memory log (contiguous seq), it never truncates it —
+        # only a journal that was ALREADY compacted when this process
+        # opened it starts the log past seq 1.
+        self._repl_log: List[bytes] = []    # fleet-global: stream frames
+        self._repl_base = 0                 # fleet-global: stream offset
         self._repl_subs: List[dict] = []    # fleet-global: subscribers
         # newest ephemeral lease heartbeat (a seq-0 frame) + a counter
         # so each subscriber can tell "a fresher one arrived"; only the
@@ -419,11 +724,18 @@ class Tracker:
         # a renewal that matches it except for until_ms is idempotent
         # and stays out of the journal entirely
         self._journaled_lease: Optional[dict] = None  # fleet-global
+        # WAL snapshot compaction (ISSUE 19): fold the live state into
+        # a snapshot-root every N journaled records (off by default);
+        # the pending flag keeps at most one compaction in flight
+        self._snap_every = _wal_mod.snapshot_every()   # fleet-global
+        self._snap_pending = False          # fleet-global: one in flight
         if wal_dir is not None:
             self._wal_log = _wal_mod.WriteAheadLog(wal_dir)  # fleet-global
             records = self._wal_log.open(resume=resume)
+            base = self._wal_log.base
+            self._repl_base = base          # fleet-global: stream offset
             self._repl_log = [              # fleet-global: repl backfill
-                _wal_mod.encode_record(i + 1, kind, data)
+                _wal_mod.encode_record(base + i + 1, kind, data)
                 for i, (kind, data) in enumerate(records)]
             if resume:
                 self._replay(records)
@@ -497,77 +809,18 @@ class Tracker:
 
     def _replay(self, records) -> None:
         """Restore journaled control-plane state (constructor only,
-        before the serve thread exists — no locking needed). Raw
-        mutations are deliberate: replay IS the WAL API's read side
-        (lint R003 exempts ``_replay``). Records tagged ``job`` replay
-        into that job's state; ``job_open``/``job_close`` rebuild the
-        job table itself, so a resume (or a standby promotion) re-adopts
-        EVERY live job with its own epoch."""
-        from ..telemetry import skew as _skew_mod
+        before the serve thread exists — no locking needed). The
+        per-record application is the module-level ``_replay_apply``,
+        shared byte-for-byte with snapshot compaction's fold (lint
+        R003 exempts the ``_replay*`` family — they ARE the WAL API's
+        read side). Records tagged ``job`` replay into that job's
+        state; ``job_open``/``job_close`` rebuild the job table; a
+        ``snapshot`` record (ISSUE 19) replaces the whole table with
+        its folded state and the tail replays on top — so a resume (or
+        a standby promotion) re-adopts EVERY live job with its own
+        epoch in time bounded by live state, not history."""
         for kind, data in records:
-            jid = str(data.get("job", _jobs_mod.DEFAULT_JOB))
-            if kind == "job_open":
-                # a journaled open proves multi-job was on when written
-                self.multi_job = True
-                prev = self._jobs.get(jid)
-                if prev is None or not prev.open:
-                    self._jobs[jid] = _jobs_mod.JobState(
-                        jid, int(data.get("nworkers", self.nworkers)),
-                        elastic=bool(data.get("elastic", False)))
-                    if jid != _jobs_mod.DEFAULT_JOB:
-                        self._orphan_jobs.add(jid)
-                continue
-            if kind == "job_close":
-                closing = self._jobs.get(jid)
-                if closing is not None:
-                    closing.close(str(data.get("reason", "")))
-                self._orphan_jobs.discard(jid)
-                continue
-            job = self._jobs.get(jid)
-            if job is None:
-                # tagged records outlived a torn job_open: the tags
-                # themselves prove the job existed — adopt it
-                self.multi_job = True
-                job = _jobs_mod.JobState(jid, self.nworkers,
-                                         elastic=self.elastic)
-                self._jobs[jid] = job
-                if jid != _jobs_mod.DEFAULT_JOB:
-                    self._orphan_jobs.add(jid)
-            if kind == "assign":
-                job._ranks[str(data["task"])] = int(data["rank"])
-            elif kind == "epoch":
-                job._epoch = int(data["epoch"])
-                if job.elastic and job._member is not None:
-                    job._member.formed(data.get("members", []))
-            elif kind == "park":
-                if job.elastic and job._member is not None:
-                    job._member.park(int(data["rank"]))
-            elif kind == "evict":
-                if job.elastic and job._member is not None:
-                    job._member.evict(int(data["rank"]))
-            elif kind == "topo":
-                job._topo = dict(data.get("doc") or {})
-            elif kind == "skew":
-                digest = dict(data.get("digest") or {})
-                job._skew = digest
-                job._skew_election = _skew_mod.FleetElection.seeded(
-                    digest)
-            elif kind == "endpoint":
-                job._endpoints[str(data["task"])] = dict(data["doc"])
-            elif kind == "down":
-                job._shutdown_ranks.add(int(data["rank"]))
-            elif kind == "resume":
-                self.restarts = int(data.get("restarts", self.restarts))
-            elif kind == "promoted":
-                # a journaled failover outlives the promoted process:
-                # a later resume keeps reporting the measured duration
-                self.promoted_wall = float(data.get("wall", 0.0))
-                self.promoted_mono = float(data.get("mono", 0.0))
-                self.failover_duration_ms = float(
-                    data.get("failover_ms", 0.0))
-            elif kind == _wal_mod.LEASE_KIND:
-                self._lease = dict(data)
-                self._journaled_lease = dict(data)
+            _replay_apply(self, kind, data)
         for job in self._jobs.values():
             if job.open and job._epoch > 0:
                 job.mark_live()
@@ -630,6 +883,49 @@ class Tracker:
             self._repl_cv.notify_all()
             if jid is not None:
                 self._mirror_job_record_locked(jid, kind, data)
+            if self._snap_every and not self._snap_pending and \
+                    seq - self._wal_log.snapshot_seq >= self._snap_every:
+                # compact OFF the journaling path: a service-pool task
+                # folds the state under _lock -> _repl_cv (the
+                # established order; this frame is already durable)
+                self._snap_pending = True
+                self._svc.submit("__wal_snapshot__",
+                                 self._take_snapshot)
+
+    def _take_snapshot(self) -> None:
+        """One live WAL compaction (service-pool task, never the wire
+        path): serialize the replay-reachable state under the tracker
+        lock, atomically rewrite the journal as snapshot-root + future
+        tail, and publish the exact snapshot frame to the replication
+        stream (followers adopt it as an append or a seq jump). Open
+        per-job mirrors compact best-effort with their own slice."""
+        try:
+            with self._lock:
+                if self._wal_log is None or self.crashed:
+                    return
+                state = snapshot_state(self)
+                with self._repl_cv:
+                    _seq, frame = self._wal_log.snapshot(state)
+                    self._repl_log.append(frame)
+                    self._repl_cv.notify_all()
+                    for jid, w in list(self._job_wals.items()):
+                        jd = state["jobs"].get(jid)
+                        if jd is None:
+                            continue
+                        try:
+                            w.snapshot({"multi_job": True,
+                                        "jobs": {jid: jd}})
+                        except Exception:  # pragma: no cover - mirror
+                            pass
+        finally:
+            # sole clearing site; worst case a duplicate compaction is
+            # scheduled, which folds to the same snapshot
+            self._snap_pending = False  # noqa: C003 - advisory flag
+
+    def snapshot_seq(self) -> int:
+        """Seq of the newest journaled snapshot (0 = none / WAL off) —
+        the ``rabit_wal_snapshot_seq`` gauge."""
+        return 0 if self._wal_log is None else self._wal_log.snapshot_seq
 
     def _mirror_job_record_locked(self, jid: str, kind: str,
                            data: dict) -> None:
@@ -694,8 +990,10 @@ class Tracker:
         on every received frame, so the gate needs no clock agreement
         between hosts."""
         lease = _wal_mod.lease_doc(self.node_id, self.lease_ms)
-        self._wal(_wal_mod.LEASE_KIND, **lease)
         with self._lock:
+            # journal + publish under ONE lock hold so a live snapshot
+            # (ISSUE 19) can never capture the state from between them
+            self._wal(_wal_mod.LEASE_KIND, **lease)
             self._lease = lease
 
     def _lease_loop(self) -> None:
@@ -746,18 +1044,23 @@ class Tracker:
             self._repl_subs.append(sub)
             hb_seen = self._repl_hb_n
         try:
-            next_seq = last + 1
+            # positional cursor into _repl_log: frame idx carries seq
+            # _repl_base + idx + 1 (the base is constant per process).
+            # A follower acked BELOW the base resynced into a compacted
+            # history — it gets the snapshot root first (idx 0) and its
+            # journal adopts the seq jump (wal.append_encoded).
+            idx = max(0, last - self._repl_base)
             while not self._done.is_set():
                 hb = None
                 with self._repl_cv:
-                    while (len(self._repl_log) < next_seq
+                    while (len(self._repl_log) <= idx
                            and self._repl_hb_n <= hb_seen
                            and not self._done.is_set()):
                         self._repl_cv.wait(0.2)
                     if self._done.is_set():
                         break
-                    if len(self._repl_log) >= next_seq:
-                        frame = self._repl_log[next_seq - 1]
+                    if len(self._repl_log) > idx:
+                        frame = self._repl_log[idx]
                     else:
                         hb = self._repl_hb
                         hb_seen = self._repl_hb_n
@@ -769,11 +1072,11 @@ class Tracker:
                     continue
                 conn.sendall(frame)
                 ack = _recv_u32(conn)
-                if ack != next_seq:
+                if ack != self._repl_base + idx + 1:
                     break   # confused follower: drop it, it resyncs
                 with self._repl_cv:
                     sub["acked"] = ack
-                next_seq += 1
+                idx += 1
         except (OSError, ConnectionError, struct.error):
             pass
         finally:
@@ -810,6 +1113,11 @@ class Tracker:
             self._metrics_server.stop()
             # main-thread lifecycle handoff; serving threads are gone
             self._metrics_server = None  # noqa: C003
+        # stop the command pool before the loop: queued handlers may
+        # still want to queue replies, and the loop's teardown drains
+        # its op queue once more so those final acks actually flush
+        self._svc.stop()
+        self._loop.stop()
         try:
             self.sock.close()
         except OSError:
@@ -856,6 +1164,10 @@ class Tracker:
         if self._metrics_server is not None:
             self._metrics_server.stop()
             self._metrics_server = None  # noqa: C003 - lifecycle teardown
+        # closing the loop hard-drops every in-flight connection — the
+        # closest a live process gets to SIGKILL's half-open sockets
+        self._svc.stop()
+        self._loop.stop()
         try:
             self.sock.close()
         except OSError:
@@ -1049,6 +1361,7 @@ class Tracker:
             polls = self._poll_count
             queued_total = self._admission.queued_total
             shed_total = self._admission.shed_total
+            preempt = dict(self.sched_preemptions)
         qdepth = len(self._admission)
         gauges = [
             ("rabit_tracker_endpoints",
@@ -1056,6 +1369,14 @@ class Tracker:
              "gauge", [(self._jl(s["id"]), s["nend"]) for s in snap]),
             ("rabit_tracker_polls_total",
              "Completed endpoint poll sweeps.", "counter", [({}, polls)]),
+            ("rabit_tracker_open_conns",
+             "Worker connections currently held by the selectors event "
+             "loop (each costs a descriptor and a buffer, never a "
+             "thread).", "gauge", [({}, self._loop.open_conns)]),
+            ("rabit_tracker_loop_lag_ms",
+             "EWMA of loop wakeup service time — the delay a newly "
+             "ready connection waits behind the current batch.",
+             "gauge", [({}, round(self._loop.lag_ms(), 4))]),
         ]
         if self._wal_log is not None:
             gauges.append((
@@ -1067,6 +1388,12 @@ class Tracker:
                 "Control-plane transitions journaled to the tracker "
                 "write-ahead log.", "counter",
                 [({}, self._wal_log.records_total)]))
+            gauges.append((
+                "rabit_wal_snapshot_seq",
+                "Seq of the journal's most recent snapshot record (0 "
+                "until one exists) — replay cost is bounded by the "
+                "tail past this point.", "gauge",
+                [({}, self._wal_log.snapshot_seq)]))
         if self.lease_ms and self._wal_log is not None:
             repl = self.repl_stats()
             gauges.append((
@@ -1184,6 +1511,13 @@ class Tracker:
                 "(exceptions that never reached the accept loop).",
                 "counter", [(self._jl(s["id"]), s["quarantined"])
                             for s in snap]))
+            gauges.append((
+                "rabit_sched_preemptions_total",
+                "Ranks preempted from running jobs by priority-class "
+                "admission, labeled by the VICTIM's class.", "counter",
+                [({"sched_class": str(c)}, n)
+                 for c, n in sorted(preempt.items())] or [
+                     ({"sched_class": "0"}, 0)]))
         if self.promoted:
             gauges.append((
                 "rabit_failover_duration_ms",
@@ -1324,13 +1658,15 @@ class Tracker:
                     # poll thread is the sole writer after _replay
                     job._skew_election = skew.FleetElection()
                 digest = job._skew_election.fold(raw)
-                if digest is not None and \
-                        digest.get("epoch") != served_epoch:
-                    # journal VERDICTS, not sweeps: the digest's epoch
-                    # bumps exactly when the election changes, so the
-                    # WAL grows with decisions rather than poll cadence
-                    self._wal("skew", digest=digest, _job=job)
                 with self._lock:
+                    if digest is not None and \
+                            digest.get("epoch") != served_epoch:
+                        # journal VERDICTS, not sweeps: the digest's
+                        # epoch bumps exactly when the election
+                        # changes, so the WAL grows with decisions
+                        # rather than poll cadence (journal + act
+                        # under one hold: snapshot consistency)
+                        self._wal("skew", digest=digest, _job=job)
                     job._last_straggler = strag
                     if digest is not None:
                         job._skew = digest
@@ -1405,66 +1741,75 @@ class Tracker:
 
     # -- serving ----------------------------------------------------------
     def _serve(self) -> None:
+        """The serve thread's body: run the readiness loop (ISSUE 19).
+        Accept, read and write readiness for every worker connection
+        live on this ONE thread; parsed commands drain through the
+        fixed service pool."""
         try:
-            self.sock.settimeout(0.2)
-        except OSError:  # stop() closed the socket before we started
-            return
-        while not self._done.is_set():
-            try:
-                conn, _ = self.sock.accept()
-            except socket.timeout:
-                continue
-            except OSError:
-                break
-            t = threading.Thread(target=self._handle, args=(conn,),
-                                 daemon=True)
-            t.start()
+            self._loop.add_listener(self.sock, self._on_accept)
+        except (OSError, ValueError):
+            return  # stop() closed the socket before we started
+        self._svc.start()
+        self._loop.run()
 
-    def _handle(self, conn: socket.socket) -> None:
-        """Preamble parse + job routing. Job-scoped command handling
-        lives in ``_dispatch``; any exception it raises (a malformed
-        payload, a poisoned JobState) is caught HERE at the job
-        boundary and quarantined — it must never unwind into the
-        accept loop or take a neighbor job down with it."""
+    def _on_accept(self, conn) -> None:
+        """Loop-thread accept callback: arm the incremental wire
+        parser. No blocking work here — the loop owns this thread."""
+        self._loop.start_parse(conn, _parse_command(), self._on_command)
+
+    def _on_command(self, conn, parsed) -> None:
+        """One full request parsed (loop thread): resolve the job
+        address and enqueue onto its command queue. The fixed service
+        pool serves queues round-robin across jobs, so one job's storm
+        cannot starve a neighbor's commands."""
+        if parsed is None:        # bad magic: hang up, exactly as before
+            self._loop.close_conn(conn)
+            return
+        cmd, task_id, args = parsed
         job_id = _jobs_mod.DEFAULT_JOB
+        if self.multi_job:
+            job_id, task_id = _jobs_mod.split_task(task_id)
+        self._svc.submit(job_id, lambda: self._handle(
+            conn, cmd, job_id, task_id, args))
+
+    def _reply_u32(self, conn, v: int, close: bool = True) -> None:
+        """Queue a u32 reply on the loop (the non-blocking twin of
+        ``_send_u32``); ``close`` hangs up once it drains."""
+        self._loop.send(conn, struct.pack("<I", v), close_after=close)
+
+    def _reply_str(self, conn, s: str, close: bool = True) -> None:
+        b = s.encode()
+        self._loop.send(conn, struct.pack("<I", len(b)) + b,
+                        close_after=close)
+
+    def _handle(self, conn, cmd: str, job_id: str, task_id: str,
+                args: tuple) -> None:
+        """Job-scoped command execution on a service-pool thread. Any
+        exception ``_dispatch`` raises (a malformed payload, a
+        poisoned JobState) is caught HERE at the job boundary and
+        quarantined — it must never unwind into the service pool or
+        take a neighbor job down with it."""
         try:
-            magic = _recv_u32(conn)
-            if magic != MAGIC:
-                conn.close()
-                return
-            cmd = _recv_str(conn)
-            task_id = _recv_str(conn)
-            _recv_u32(conn)  # num_attempt (informational)
-            if self.multi_job:
-                job_id, task_id = _jobs_mod.split_task(task_id)
             try:
-                self._dispatch(conn, cmd, job_id, task_id)
+                self._dispatch(conn, cmd, job_id, task_id, args)
             except (ConnectionError, OSError, struct.error):
                 raise   # wire-level failures are the peer's problem
             except Exception as e:  # noqa: BLE001 - job fault boundary
                 self._quarantine(job_id, cmd, e)
-                try:
-                    conn.close()
-                except OSError:
-                    pass
+                self._loop.close_conn(conn)
         except (ConnectionError, OSError, struct.error):
-            try:
-                conn.close()
-            except OSError:
-                pass
+            self._loop.close_conn(conn)
 
-    def _dispatch(self, conn: socket.socket, cmd: str, job_id: str,
-                  task_id: str) -> None:
+    def _dispatch(self, conn, cmd: str, job_id: str, task_id: str,
+                  args: tuple) -> None:
         if cmd == "print":
-            msg = _recv_str(conn)
+            msg = args[0]
             self.messages.append(msg)
             print(msg, flush=True)
-            _send_u32(conn, 1)
-            conn.close()
+            self._reply_u32(conn, 1)
         elif cmd == "metrics":
-            payload = _recv_str(conn)
             try:
-                doc = json.loads(payload)
+                doc = json.loads(args[0])
             except ValueError:
                 doc = None
             job = self._job_for(job_id)
@@ -1472,12 +1817,10 @@ class Tracker:
             if ok:
                 with self._lock:
                     job._metrics[task_id] = doc
-            _send_u32(conn, 1 if ok else 0)
-            conn.close()
+            self._reply_u32(conn, 1 if ok else 0)
         elif cmd == "endpoint":
-            payload = _recv_str(conn)
             try:
-                doc = json.loads(payload)
+                doc = json.loads(args[0])
             except ValueError:
                 doc = None
             job = self._job_for(job_id)
@@ -1487,31 +1830,31 @@ class Tracker:
                 ep = {"host": str(doc["host"]),
                       "port": int(doc["port"]),
                       "rank": int(doc.get("rank", -1))}
-                self._wal("endpoint", task=task_id, doc=ep, _job=job)
                 with self._lock:
+                    # journal + act under ONE lock hold so a live
+                    # snapshot (ISSUE 19) can never capture the state
+                    # from between them
+                    self._wal("endpoint", task=task_id, doc=ep,
+                              _job=job)
                     job._endpoints[task_id] = ep
                     # a re-announce is proof of life: a stale miss
                     # count from before a tracker outage must not
                     # carry over into fresh eviction evidence
                     job._endpoint_misses[task_id] = 0
-            _send_u32(conn, 1 if ok else 0)
-            conn.close()
+            self._reply_u32(conn, 1 if ok else 0)
         elif cmd == "topo":
             job = self._job_for(job_id)
             with self._lock:
                 doc = {} if job is None else dict(job._topo)
-            _send_str(conn, json.dumps(doc))
-            conn.close()
+            self._reply_str(conn, json.dumps(doc))
         elif cmd == "skew":
             job = self._job_for(job_id)
             with self._lock:
                 doc = {} if job is None else dict(job._skew)
-            _send_str(conn, json.dumps(doc))
-            conn.close()
+            self._reply_str(conn, json.dumps(doc))
         elif cmd == "world":
-            _send_str(conn, json.dumps(
+            self._reply_str(conn, json.dumps(
                 self.membership_doc(self._job_for(job_id))))
-            conn.close()
         elif cmd == "resume":
             # post-restart handshake (ISSUE 10): a live worker
             # re-presents its (task_id, stable_rank, epoch) so the
@@ -1520,9 +1863,8 @@ class Tracker:
             # outage. Ack 1 = identities agree (or were adopted),
             # 0 = mismatch — the worker should fall back to a full
             # re-registration.
-            payload = _recv_str(conn)
             try:
-                doc = json.loads(payload)
+                doc = json.loads(args[0])
             except ValueError:
                 doc = None
             job = self._job_for(job_id)
@@ -1532,12 +1874,10 @@ class Tracker:
                 ok = self._resume_present(
                     job, task_id, int(doc["rank"]),
                     int(doc.get("epoch", 0)))
-            _send_u32(conn, 1 if ok else 0)
-            conn.close()
+            self._reply_u32(conn, 1 if ok else 0)
         elif cmd == "evict":
-            payload = _recv_str(conn)
             try:
-                doc = json.loads(payload)
+                doc = json.loads(args[0])
             except ValueError:
                 doc = None
             job = self._job_for(job_id)
@@ -1547,25 +1887,24 @@ class Tracker:
                 ok = self.evict_rank(int(doc["rank"]),
                                      str(doc.get("reason", "")),
                                      job=job)
-            _send_u32(conn, 1 if ok else 0)
-            conn.close()
+            self._reply_u32(conn, 1 if ok else 0)
         elif cmd == "repl":
-            self._serve_repl(conn, task_id)
+            # replication subscribers live for the tracker's lifetime,
+            # not a request's: detach the socket from the loop (loop
+            # thread) and hand it to a dedicated streamer thread —
+            # bounded by the number of standbys, never by connections
+            self._loop.call(lambda: self._detach_repl(conn, task_id))
         elif cmd == "submit":
             # admission control: answer IMMEDIATELY with a verdict
             # (admitted / queued+retry_after / shed+retry_after) —
             # overload sheds, it never stalls a submitter's socket
-            payload = _recv_str(conn)
-            _send_str(conn, json.dumps(self._submit(payload)))
-            conn.close()
+            self._reply_str(conn, json.dumps(self._submit(args[0])))
         elif cmd == "join":
-            host = _recv_str(conn)
-            port = _recv_u32(conn)
-            flags = _recv_u32(conn)
-            token = _recv_str(conn)
+            host, port, flags, token = args
             job = self._job_for_register(job_id)
             if job is None:
-                conn.close()   # admission refused: shed, never parked
+                # admission refused: shed, never parked
+                self._loop.close_conn(conn)
                 return
             self._register(conn, job, task_id, host, port, flags,
                            token, join=True)
@@ -1582,22 +1921,36 @@ class Tracker:
                         self._wal("down", rank=rank, _job=job)
                         job._shutdown_ranks.add(rank)
                     all_down = job.all_down_locked()
-            _send_u32(conn, 1)
-            conn.close()
+            self._reply_u32(conn, 1)
             if all_down:
                 self._job_complete(job)
         elif cmd in ("start", "recover"):
-            host = _recv_str(conn)
-            port = _recv_u32(conn)
-            flags = _recv_u32(conn)
-            token = _recv_str(conn)
+            host, port, flags, token = args
             job = self._job_for_register(job_id)
             if job is None:
-                conn.close()   # admission refused: shed, never parked
+                # admission refused: shed, never parked
+                self._loop.close_conn(conn)
                 return
             self._register(conn, job, task_id, host, port, flags, token)
         else:
-            conn.close()
+            self._loop.close_conn(conn)
+
+    def _detach_repl(self, conn, peer: str) -> None:
+        """Loop-thread half of the ``repl`` arm: pull the socket out of
+        readiness-land (back to blocking) and start its streamer."""
+        if conn.closed or conn.detached:
+            return
+        raw, leftover = self._loop.detach(conn)
+        if leftover:
+            # protocol violation: a follower must wait for the
+            # tracker's ok before sending its resync seq
+            try:
+                raw.close()
+            except OSError:
+                pass
+            return
+        threading.Thread(target=self._serve_repl, args=(raw, peer),
+                         name="rabit-tracker-repl", daemon=True).start()
 
     # -- multi-job admission + fault domains (ISSUE 15) -------------------
     def _quarantine(self, job_id: str, cmd: str, exc: Exception) -> None:
@@ -1673,7 +2026,16 @@ class Tracker:
         if n < 1:
             return {"ok": 0, "error": "nworkers must be >= 1"}
         elastic = bool(doc.get("elastic", self.elastic))
-        retry = _jobs_mod.RETRY_AFTER_MS_DEFAULT
+        try:
+            cls = max(0, int(doc.get("sched_class", 0)))
+        except (TypeError, ValueError):
+            cls = 0
+        try:
+            weight = float(doc.get("weight", 1.0))
+        except (TypeError, ValueError):
+            weight = 1.0
+        if weight <= 0:
+            weight = 1.0
         self._reap_orphans()   # free capacity held by pre-crash jobs
         with self._lock:
             job = self._jobs.get(job_id)
@@ -1686,11 +2048,24 @@ class Tracker:
                                  f"rabit_max_fleet_ranks "
                                  f"{self._max_fleet_ranks}"}
             if self._fits_locked(n):
-                self._open_job_locked(job_id, n, elastic)
+                self._open_job_locked(job_id, n, elastic, cls, weight)
+                self.submit_admitted_total += 1
+                return {"ok": 1, "job": job_id}
+            plan = self._plan_preemption_locked(n, cls) if cls else None
+        if plan:
+            verdict = self._preempt(job_id, n, elastic, cls, weight,
+                                    plan)
+            if verdict is not None:
+                return verdict
+        retry = self._retry_hint_ms()
+        with self._lock:
+            if self._fits_locked(n):   # capacity freed while unlocked
+                self._open_job_locked(job_id, n, elastic, cls, weight)
                 self.submit_admitted_total += 1
                 return {"ok": 1, "job": job_id}
             pos = self._admission.offer(
-                {"job": job_id, "nworkers": n, "elastic": elastic})
+                {"job": job_id, "nworkers": n, "elastic": elastic,
+                 "sched_class": cls, "weight": weight})
             if pos < 0:
                 depth = len(self._admission)
                 return {"ok": 0, "shed": 1,
@@ -1698,27 +2073,125 @@ class Tracker:
             return {"ok": 0, "queued": 1, "position": pos,
                     "retry_after_ms": retry * (pos + 1)}
 
+    def _retry_hint_ms(self) -> int:
+        """Backoff hint from the MEASURED drain rate: the mean gap
+        between recent job closes says how long a queue slot takes to
+        free. Falls back to the old constant until two closes have
+        been observed (and under multi-job OFF, where no job ever
+        closes while the tracker serves)."""
+        with self._lock:
+            ts = list(self._drain_t)
+        if len(ts) >= 2:
+            per_close_s = (ts[-1] - ts[0]) / (len(ts) - 1)
+            if per_close_s > 0:
+                return max(50, min(60_000, int(per_close_s * 1e3)))
+        return _jobs_mod.RETRY_AFTER_MS_DEFAULT
+
     def _fits_locked(self, nworkers: int) -> bool:
         """Would a job of ``nworkers`` fit under the admission caps
         right now? Caller holds the lock. The pre-created default job
-        does not count until it has registered anyone."""
+        does not count until it has registered anyone. Capacity sums
+        ``quota`` (== nworkers until a preemption shrinks it), so
+        preempted ranks are genuinely transferable."""
         open_jobs = [jb for jb in self._jobs.values()
                      if jb.open and (jb.job_id != _jobs_mod.DEFAULT_JOB
                                      or jb._ranks or jb._pending)]
         if len(open_jobs) >= self._max_jobs:
             return False
         if self._max_fleet_ranks:
-            in_use = sum(jb.nworkers for jb in open_jobs)
+            in_use = sum(jb.quota for jb in open_jobs)
             if in_use + nworkers > self._max_fleet_ranks:
                 return False
         return True
 
-    def _open_job_locked(self, job_id: str, nworkers: int, elastic: bool):
+    def _plan_preemption_locked(self, n: int, cls: int):
+        """Victim ranks whose eviction would fit an ``n``-rank
+        class-``cls`` job under ``rabit_max_fleet_ranks``. Caller
+        holds the lock; execution happens OUTSIDE it (evict_rank
+        re-takes the lock). Victims are elastic open jobs of strictly
+        lower class, lowest class first, highest live rank first; each
+        keeps at least one rank so its survivors re-form. None = the
+        shortfall cannot be covered (or the blocker is the job-count
+        cap, which preemption cannot fix)."""
+        if not self._max_fleet_ranks:
+            return None
+        open_jobs = [jb for jb in self._jobs.values()
+                     if jb.open and (jb.job_id != _jobs_mod.DEFAULT_JOB
+                                     or jb._ranks or jb._pending)]
+        if len(open_jobs) >= self._max_jobs:
+            return None
+        need = n - (self._max_fleet_ranks
+                    - sum(jb.quota for jb in open_jobs))
+        if need <= 0:
+            return None
+        victims = sorted(
+            (jb for jb in open_jobs
+             if jb.elastic and jb.sched_class < cls
+             and jb._member is not None),
+            key=lambda jb: (jb.sched_class, jb.job_id))
+        plan = []
+        for jb in victims:
+            if need <= 0:
+                break
+            live = sorted(jb._member.live, reverse=True)
+            take = min(need, jb.quota - 1, max(0, len(live) - 1))
+            for r in live[:take]:
+                plan.append((jb, r))
+            need -= max(0, take)
+        return plan if plan and need <= 0 else None
+
+    def _preempt(self, job_id: str, n: int, elastic: bool, cls: int,
+                 weight: float, plan) -> Optional[dict]:
+        """Execute a preemption plan: evict the victim ranks (the
+        existing elastic evict path — survivors re-form at the smaller
+        world), transfer the freed quota, and admit the submitter.
+        Returns the admitted verdict, or None if the plan raced stale
+        (capacity moved between planning and execution — the caller
+        falls back to the queue)."""
+        evicted: Dict[object, int] = {}
+        for jb, r in plan:
+            if self.evict_rank(r, f"preempted by class {cls} job "
+                               f"{job_id}", job=jb):
+                evicted[jb] = evicted.get(jb, 0) + 1
+        if not evicted:
+            return None
+        with self._lock:
+            for jb, cnt in evicted.items():
+                jb.quota = max(1, jb.quota - cnt)
+                jb.preempted += cnt
+                # journal + act under one hold (snapshot consistency)
+                self._wal("quota", quota=jb.quota,
+                          preempted=jb.preempted, _job=jb)
+                by_class = self.sched_preemptions
+                by_class[jb.sched_class] = \
+                    by_class.get(jb.sched_class, 0) + cnt
+            if not self._fits_locked(n):
+                return None
+            self._open_job_locked(job_id, n, elastic, cls, weight)
+            self.submit_admitted_total += 1
+        total = sum(evicted.values())
+        print(f"[tracker] admitted class {cls} job {job_id} by "
+              f"preempting {total} rank(s) from "
+              f"{', '.join(sorted(jb.job_id for jb in evicted))}",
+              file=sys.stderr, flush=True)
+        return {"ok": 1, "job": job_id, "preempted": total}
+
+    def _open_job_locked(self, job_id: str, nworkers: int, elastic: bool,
+                         sched_class: int = 0, weight: float = 1.0):
         """Create + journal a job (caller holds the lock and has
-        already verified it fits)."""
-        job = _jobs_mod.JobState(job_id, nworkers, elastic=elastic)
+        already verified it fits). Scheduler fields ride the
+        ``job_open`` record only when non-default, so a scheduler-less
+        WAL stays byte-identical."""
+        job = _jobs_mod.JobState(job_id, nworkers, elastic=elastic,
+                                 sched_class=sched_class,
+                                 sched_weight=weight)
+        extra = {}
+        if sched_class:
+            extra["sched_class"] = int(sched_class)
+        if weight != 1.0:
+            extra["weight"] = float(weight)
         self._wal("job_open", job=job_id, nworkers=int(nworkers),
-                  elastic=bool(elastic))
+                  elastic=bool(elastic), **extra)
         self._jobs[job_id] = job
         self._job_contact[job_id] = time.monotonic()
         return job
@@ -1727,6 +2200,9 @@ class Tracker:
         if job.open:
             self._wal("job_close", job=job.job_id, reason=reason)
             job.close(reason)
+            # one drain-rate sample per close: the admission plane's
+            # retry_after_ms hint is measured, not guessed
+            self._drain_t.append(time.monotonic())
 
     def _admit_queued_locked(self) -> List[str]:
         """Admit queued submissions in strict FIFO order while the
@@ -1740,7 +2216,9 @@ class Tracker:
                 break
             self._admission.pop_front()
             self._open_job_locked(head["job"], head["nworkers"],
-                                  head["elastic"])
+                                  head["elastic"],
+                                  int(head.get("sched_class", 0)),
+                                  float(head.get("weight", 1.0)))
             admitted.append(head["job"])
         return admitted
 
@@ -1871,7 +2349,12 @@ class Tracker:
     def _register(self, conn, job, task_id: str, host: str, port: int,
                   flags: int = 0, token: str = "",
                   join: bool = False) -> None:
+        """Registration is non-blocking now (ISSUE 19): a worker whose
+        batch is incomplete simply leaves its connection parked in
+        ``job._pending`` — no thread waits on it. Whichever command
+        completes the batch serves everyone via ``_assign``."""
         grace_s: Optional[float] = None
+        prev = None
         with self._cv:
             if task_id not in job._ranks:
                 rank = len(job._ranks)
@@ -1886,7 +2369,7 @@ class Tracker:
                 job._ranks[task_id] = rank
             rank = job._ranks[task_id]
             if rank >= job.nworkers:
-                conn.close()
+                self._loop.close_conn(conn)
                 return
             if job.elastic:
                 m = job._member
@@ -1898,25 +2381,31 @@ class Tracker:
                     m.park(rank)
                     grace_s = _membership.join_grace_ms() / 1e3 or None
             job._shutdown_ranks.discard(rank)
+            prev = job._pending.get(rank)
             job._pending[rank] = (conn, host, port, flags, token)
             got = self._try_complete_batch_locked(job)
-            if got is None:
-                self._cv.wait_for(
-                    lambda: rank not in job._pending
-                    or self._done.is_set(), timeout=grace_s)
-                if rank in job._pending and \
-                        job._pending[rank][0] is conn:
-                    # parked joiner outlived rabit_join_grace_ms with
-                    # no epoch boundary: bounce it (the joiner retries)
-                    # rather than hold its socket open forever
-                    del job._pending[rank]
-                    try:
-                        conn.close()
-                    except OSError:
-                        pass
-                return  # the completing thread serves everyone
-            batch, epoch = got
-        self._assign(job, batch, epoch)
+        if prev is not None and prev[0] is not conn:
+            # a re-registration superseded a still-parked connection
+            self._loop.close_conn(prev[0])
+        if got is None:
+            if grace_s is not None:
+                # parked joiner: bounce it (the joiner retries) after
+                # rabit_join_grace_ms if no epoch boundary adopts it,
+                # rather than hold its socket open forever
+                self._arm_join_bounce(job, conn, rank, grace_s)
+            return
+        self._assign(job, *got)
+
+    def _arm_join_bounce(self, job, conn, rank: int,
+                         grace_s: float) -> None:
+        def bounce() -> None:  # loop thread
+            with self._cv:
+                pend = job._pending.get(rank)
+                if pend is None or pend[0] is not conn:
+                    return  # adopted (or superseded) in time
+                del job._pending[rank]
+            self._loop.close_conn(conn)
+        self._loop.call_later(grace_s, bounce)
 
     # -- elastic membership (ISSUE 9) -------------------------------------
     def membership_doc(self, job=None) -> dict:
@@ -1983,10 +2472,7 @@ class Tracker:
                 job.mark_failed()
         self._note_transition("evict", rank, reason or "evicted", job)
         if pend is not None:
-            try:
-                pend[0].close()
-            except OSError:
-                pass
+            self._loop.close_conn(pend[0])
         if got is not None:
             self._assign(job, *got)
         return True
@@ -2021,10 +2507,7 @@ class Tracker:
             print(f"[tracker] coordinator start failed, rejecting epoch "
                   f"{epoch}: {e}", file=sys.stderr, flush=True)
             for c in conns.values():
-                try:
-                    c.close()
-                except OSError:
-                    pass
+                self._loop.close_conn(c)
             return
         # Single-host worlds get a flag so every rank makes the SAME
         # collective-algorithm choice (the ring/tree crossover default
@@ -2061,9 +2544,53 @@ class Tracker:
             "delegates": [min(g) for g in groups],
             "single_host": single_host,
         }
-        self._wal("topo", doc=topo, _job=job)
         with self._lock:
+            # journal + act under ONE lock hold so a live snapshot
+            # (ISSUE 19) can never capture the state from between them
+            self._wal("topo", doc=topo, _job=job)
             job._topo = topo
+
+        def _pack_u32(buf: bytearray, v: int) -> None:
+            buf += struct.pack("<I", v)
+
+        def _pack_str(buf: bytearray, s: str) -> None:
+            b = s.encode()
+            buf += struct.pack("<I", len(b))
+            buf += b
+
+        # ready-ack barrier: each worker's 4-byte ack arrives via the
+        # loop (no blocking reads); the counters below are mutated ONLY
+        # by loop-thread callbacks, so they need no lock. A worker dying
+        # pre-ack is logged, not swallowed: the epoch still completes
+        # (the dead worker re-registers into the NEXT epoch after
+        # respawn) but the operator can see why a recovery round
+        # happened. teardown-before-ack contract: once EVERY member
+        # acked epoch N, no client of an epoch < N exists anywhere ->
+        # reap old services (on the service pool: reaping takes the
+        # tracker lock and can block on service joins).
+        state = {"left": len(conns), "all_acked": True}
+
+        def _settle() -> None:  # loop thread
+            state["left"] -= 1
+            if state["left"] == 0 and state["all_acked"]:
+                self._svc.submit(
+                    job.job_id,
+                    lambda: self._reap_old_services(job, epoch))
+
+        def _on_ack(c, _data) -> None:  # loop thread
+            self._loop.close_conn(c)
+            _settle()
+
+        def _make_on_fail(rank):
+            def _on_fail(c, exc) -> None:  # loop thread
+                state["all_acked"] = False
+                print(f"[tracker] rank {rank} did not ack epoch "
+                      f"{epoch} ({type(exc).__name__}: {exc})",
+                      file=sys.stderr, flush=True)
+                self._loop.close_conn(c)
+                _settle()
+            return _on_fail
+
         for rank in sorted(slot_of.values()):
             conn = conns[rank]
             parent, children = tree_neighbors(rank, world)
@@ -2075,57 +2602,35 @@ class Tracker:
                                 else set()))
             connect_to = [r for r in neighbors if r < rank]
             naccept = len([r for r in neighbors if r > rank])
-            try:
-                _send_u32(conn, rank)
-                _send_u32(conn, world)
-                _send_u32(conn, epoch)
-                _send_str(conn, coord_host)
-                _send_u32(conn, coord_port)
-                _send_u32(conn, 1 if single_host else 0)
-                _send_u32(conn, NO_RANK if parent is None else parent)
-                _send_u32(conn, len(tree_nbrs))
-                for r in tree_nbrs:
-                    _send_u32(conn, r)
-                _send_u32(conn, ring_prev)
-                _send_u32(conn, ring_next)
-                _send_u32(conn, len(connect_to))
-                for r in connect_to:
-                    peer_host, peer_port, peer_tok = addr[r]
-                    if self._link_rewrite is not None:
-                        peer_host, peer_port = self._link_rewrite(
-                            r, peer_host, peer_port)
-                        peer_tok = ""  # UDS would bypass the proxy
-                    _send_u32(conn, r)
-                    _send_str(conn, peer_host)
-                    _send_u32(conn, int(peer_port))
-                    _send_str(conn, peer_tok)
-                _send_u32(conn, naccept)
-            except OSError:
-                pass
-        # ready acks (worker finished wiring). A worker dying pre-ack
-        # surfaces here as a connection error — logged, not swallowed:
-        # the epoch still completes (the dead worker re-registers into
-        # the NEXT epoch after respawn) but the operator can see why a
-        # recovery round happened.
-        all_acked = True
-        for rank, conn in conns.items():
-            try:
-                conn.settimeout(self._ready_timeout)
-                _recv_u32(conn)
-            except (OSError, ConnectionError, struct.error) as e:
-                all_acked = False
-                print(f"[tracker] rank {rank} did not ack epoch {epoch} "
-                      f"({type(e).__name__}: {e})", file=sys.stderr,
-                      flush=True)
-            finally:
-                try:
-                    conn.close()
-                except OSError:
-                    pass
-        # teardown-before-ack contract: once EVERY member acked epoch N,
-        # no client of an epoch < N exists anywhere -> reap old services
-        if all_acked:
-            self._reap_old_services(job, epoch)
+            blob = bytearray()
+            _pack_u32(blob, rank)
+            _pack_u32(blob, world)
+            _pack_u32(blob, epoch)
+            _pack_str(blob, coord_host)
+            _pack_u32(blob, coord_port)
+            _pack_u32(blob, 1 if single_host else 0)
+            _pack_u32(blob, NO_RANK if parent is None else parent)
+            _pack_u32(blob, len(tree_nbrs))
+            for r in tree_nbrs:
+                _pack_u32(blob, r)
+            _pack_u32(blob, ring_prev)
+            _pack_u32(blob, ring_next)
+            _pack_u32(blob, len(connect_to))
+            for r in connect_to:
+                peer_host, peer_port, peer_tok = addr[r]
+                if self._link_rewrite is not None:
+                    peer_host, peer_port = self._link_rewrite(
+                        r, peer_host, peer_port)
+                    peer_tok = ""  # UDS would bypass the proxy
+                _pack_u32(blob, r)
+                _pack_str(blob, peer_host)
+                _pack_u32(blob, int(peer_port))
+                _pack_str(blob, peer_tok)
+            _pack_u32(blob, naccept)
+            self._loop.send(conn, bytes(blob))
+            self._loop.expect(conn, 4, _on_ack,
+                              timeout=self._ready_timeout,
+                              on_fail=_make_on_fail(rank))
 
 
 def _main(argv: Optional[List[str]] = None) -> int:

@@ -25,6 +25,16 @@ File format (all integers little-endian)::
 ``seq`` starts at 1 and increments by exactly 1 per record — replay is
 deterministic and any reordering or splice is detected as corruption.
 
+Snapshot compaction (ISSUE 19): a ``snapshot`` record carries the FULL
+folded control-plane state (``wal_snapshot/v1``, built by
+``tracker.fold_records`` / the live tracker's serializer) and replay is
+snapshot + tail. A compacted journal's FIRST record is a snapshot whose
+seq continues the pre-compaction numbering (seq N+1 after N folded
+records) — the implicit ``base = seq - 1`` — so the replication stream,
+follower acks, and every later record keep one monotonic seq space
+across compactions. A week-old tracker resumes in time bounded by its
+LIVE state, not its history.
+
 Durability rules follow ``engine/ckpt_store.py``:
 
 - a FRESH log is created as ``.tmp-<pid>`` (header only), fsynced,
@@ -68,6 +78,27 @@ LOG_NAME = "tracker.wal"
 MAX_RECORD_BYTES = 16 << 20
 
 WAL_DIR_ENV = "RABIT_TRACKER_WAL_DIR"
+
+# snapshot compaction (ISSUE 19): the record kind whose data carries
+# the full folded state ({"v": "wal_snapshot/v1", "state": {...},
+# "ts": wall-seconds}); replay = snapshot + tail
+SNAPSHOT_KIND = "snapshot"
+SNAPSHOT_V = "wal_snapshot/v1"
+SNAPSHOT_EVERY_ENV = "RABIT_WAL_SNAPSHOT_EVERY"
+SNAPSHOT_EVERY_DEFAULT = 0         # 0 = live compaction off
+
+
+def snapshot_every() -> int:
+    """``rabit_wal_snapshot_every``: journal a compacting snapshot
+    after this many records since the last one (0 = never, the
+    default — byte-identical journals). The tracker folds its live
+    state off the hot path and atomically rewrites the journal as
+    snapshot-root + future tail."""
+    try:
+        return max(0, int(os.environ.get(SNAPSHOT_EVERY_ENV,
+                                         SNAPSHOT_EVERY_DEFAULT)))
+    except ValueError:
+        return SNAPSHOT_EVERY_DEFAULT
 
 
 class WalError(RuntimeError):
@@ -253,6 +284,12 @@ class WriteAheadLog:
         self._lock = threading.Lock()
         self._fh = None
         self._seq = 0
+        # snapshot compaction (ISSUE 19): records before the snapshot
+        # root are folded away — seq numbering continues from _base,
+        # and snapshot_seq is the newest snapshot record's seq (0 =
+        # none; the rabit_wal_snapshot_seq gauge reads it)
+        self._base = 0
+        self.snapshot_seq = 0
         self.records_total = 0
         self.truncated_bytes = 0
 
@@ -272,9 +309,11 @@ class WriteAheadLog:
             with self._lock:
                 self._fh = open(self.path, "ab")
                 self._seq = 0
+                self._base = 0
+                self.snapshot_seq = 0
                 self.records_total = 0
             return []
-        records, end = self._scan()
+        records, end, base = self._scan()
         size = os.path.getsize(self.path)
         if end < size:
             # torn tail: a crash mid-append left a partial frame or a
@@ -282,9 +321,15 @@ class WriteAheadLog:
             # intact transition
             self.truncated_bytes = size - end
             os.truncate(self.path, end)
+        snap = 0
+        for i, (kind, _data) in enumerate(records):
+            if kind == SNAPSHOT_KIND:
+                snap = base + i + 1
         with self._lock:
             self._fh = open(self.path, "ab")
-            self._seq = len(records)
+            self._seq = base + len(records)
+            self._base = base
+            self.snapshot_seq = snap
             self.records_total = len(records)
         return records
 
@@ -318,12 +363,23 @@ class WriteAheadLog:
         frame from the leader) byte-for-byte, after re-validating its
         CRC and sequence continuity; fsyncs before returning so the ack
         the follower sends back only ever covers durable records.
-        Returns the record's ``seq``."""
-        seq, _, _ = decode_record(frame)
+        Returns the record's ``seq``.
+
+        A ``snapshot`` frame whose seq JUMPS past this journal's tail
+        is a leader that compacted beyond our resync point: the
+        snapshot subsumes every record we hold, so the journal is
+        atomically rewritten as snapshot-root + future tail instead of
+        raising (a follower must be able to adopt a compacted
+        history). A contiguous snapshot frame is a plain append — a
+        mid-journal snapshot replays fine."""
+        seq, kind, _ = decode_record(frame)
         with self._lock:
             if self._fh is None:
                 raise WalError("journal is not open")
             if seq != self._seq + 1:
+                if kind == SNAPSHOT_KIND and seq > self._seq:
+                    self._rewrite_locked(frame, seq)
+                    return seq
                 raise WalCorruptError(
                     f"replicated record has seq {seq}, journal is at "
                     f"{self._seq} (resync from the last acked seq)")
@@ -331,8 +387,54 @@ class WriteAheadLog:
             self._fh.flush()
             os.fsync(self._fh.fileno())
             self._seq = seq
+            if kind == SNAPSHOT_KIND:
+                self.snapshot_seq = seq
             self.records_total += 1
             return seq
+
+    def snapshot(self, state: Dict[str, Any],
+                 ts: Optional[float] = None) -> Tuple[int, bytes]:
+        """Compact the journal: fold everything before ``state`` away
+        by atomically rewriting the file as header + one ``snapshot``
+        record whose seq CONTINUES the numbering (``base`` becomes
+        seq - 1). Returns ``(seq, frame)`` — the caller publishes the
+        exact frame to replication subscribers so follower journals
+        stay byte-identical. ``state`` must be the fold of every
+        record up to the journal's current tail (the tracker
+        serializes this under its own lock; write-ahead means the
+        journal never runs ahead of acted-on state)."""
+        data = {"v": SNAPSHOT_V, "state": state,
+                "ts": round(time.time(), 3) if ts is None else ts}
+        with self._lock:
+            if self._fh is None:
+                raise WalError("journal is not open")
+            seq = self._seq + 1
+            frame = encode_record(seq, SNAPSHOT_KIND, data)
+            self._rewrite_locked(frame, seq)
+            return seq, frame
+
+    def _rewrite_locked(self, frame: bytes, seq: int) -> None:
+        """Atomically replace the journal with header + ``frame`` (a
+        snapshot record at ``seq``); same tmp/replace/fsync dance as a
+        fresh create, so a crash mid-compaction leaves either the old
+        journal or the new one, never a torn hybrid."""
+        tmp = os.path.join(self.root, f".tmp-{os.getpid()}")
+        with open(tmp, "wb") as f:
+            f.write(MAGIC + frame)
+            f.flush()
+            os.fsync(f.fileno())
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+        os.replace(tmp, self.path)
+        _fsync_dir(self.root)
+        self._fh = open(self.path, "ab")
+        self._seq = seq
+        self._base = seq - 1
+        self.snapshot_seq = seq
+        self.records_total = 1
 
     @property
     def seq(self) -> int:
@@ -340,15 +442,26 @@ class WriteAheadLog:
         with self._lock:
             return self._seq
 
+    @property
+    def base(self) -> int:
+        """Records folded into the snapshot root (0 = never
+        compacted): the journal's first record carries seq
+        ``base + 1``."""
+        with self._lock:
+            return self._base
+
     # -- replay -----------------------------------------------------------
     def replay(self) -> List[Tuple[str, dict]]:
         """Parse the journal without opening it for append (tools,
-        tests). Same torn-tail / corruption rules as ``open``."""
+        tests). Same torn-tail / corruption rules as ``open``. A
+        compacted journal replays as snapshot + tail."""
         return self._scan()[0]
 
-    def _scan(self) -> Tuple[List[Tuple[str, dict]], int]:
-        """Returns ``(records, clean_end_offset)``; raises
-        :class:`WalVersionError` / :class:`WalCorruptError`."""
+    def _scan(self) -> Tuple[List[Tuple[str, dict]], int, int]:
+        """Returns ``(records, clean_end_offset, base)``; raises
+        :class:`WalVersionError` / :class:`WalCorruptError`. ``base``
+        is nonzero only for a compacted journal, whose first record is
+        a snapshot continuing the pre-compaction seq numbering."""
         if not os.path.exists(self.path):
             raise WalError(f"no journal at {self.path}")
         with open(self.path, "rb") as f:
@@ -361,10 +474,11 @@ class WriteAheadLog:
             raise WalCorruptError(
                 f"journal {self.path} has bad magic {blob[:8]!r}")
         records: List[Tuple[str, dict]] = []
+        base = 0
         off = len(MAGIC)
         while off < len(blob):
             if off + _FRAME.size > len(blob):
-                return records, off  # torn frame at the tail
+                return records, off, base  # torn frame at the tail
             length, crc = _FRAME.unpack_from(blob, off)
             start = off + _FRAME.size
             end = start + length
@@ -372,7 +486,7 @@ class WriteAheadLog:
                 raise WalCorruptError(
                     f"record at offset {off} claims {length} bytes")
             if end > len(blob):
-                return records, off  # torn payload at the tail
+                return records, off, base  # torn payload at the tail
             payload = blob[start:end]
             bad: Optional[str] = None
             doc = None
@@ -385,20 +499,30 @@ class WriteAheadLog:
                     bad = "unparseable payload"
                 else:
                     if not isinstance(doc, dict) or \
-                            doc.get("seq") != len(records) + 1 or \
+                            not isinstance(doc.get("seq"), int) or \
                             not isinstance(doc.get("kind"), str) or \
                             not isinstance(doc.get("data"), dict):
                         bad = (f"bad sequence/shape "
-                               f"(want seq {len(records) + 1})")
+                               f"(want seq {base + len(records) + 1})")
+                    else:
+                        if not records and doc["seq"] > 1 and \
+                                doc["kind"] == SNAPSHOT_KIND:
+                            # compacted journal: the snapshot root
+                            # continues the folded history's numbering
+                            base = doc["seq"] - 1
+                        if doc["seq"] != base + len(records) + 1:
+                            bad = (f"bad sequence/shape "
+                                   f"(want seq {base + len(records) + 1})")
             if bad is not None:
                 if end >= len(blob):
-                    return records, off  # damaged FINAL record: torn tail
+                    # damaged FINAL record: torn tail
+                    return records, off, base
                 raise WalCorruptError(
-                    f"record {len(records) + 1} at offset {off}: {bad} "
-                    f"with {len(blob) - end} intact bytes after it")
+                    f"record {base + len(records) + 1} at offset {off}: "
+                    f"{bad} with {len(blob) - end} intact bytes after it")
             records.append((doc["kind"], doc["data"]))
             off = end
-        return records, off
+        return records, off, base
 
 
 # ------------------------------------------------------------- CI smoke
@@ -449,6 +573,38 @@ def _smoke() -> None:
             pass
         else:
             raise AssertionError("corrupt middle record not detected")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    # snapshot compaction: fold -> snapshot-root journal whose seq
+    # numbering continues, tail records append past it, and a resume
+    # replays snapshot + tail; a follower adopting a snapshot seq-JUMP
+    # rewrites its journal instead of raising
+    root = tempfile.mkdtemp(prefix="rabit-wal-smoke-")
+    try:
+        w = WriteAheadLog(root)
+        w.open()
+        for i in range(3):
+            w.record("epoch", epoch=i + 1)
+        seq, frame = w.snapshot({"fold": "of-3-records"})
+        assert (seq, w.base, w.snapshot_seq) == (4, 3, 4), \
+            (seq, w.base, w.snapshot_seq)
+        assert w.record("epoch", epoch=9) == 5
+        w.close()
+        w = WriteAheadLog(root)
+        got = w.open(resume=True)
+        assert [k for k, _d in got] == [SNAPSHOT_KIND, "epoch"], got
+        assert got[0][1]["state"] == {"fold": "of-3-records"}
+        assert (w.seq, w.base, w.snapshot_seq) == (5, 3, 4)
+        w.close()
+
+        follower = WriteAheadLog(os.path.join(root, "follower"))
+        follower.open()
+        follower.record("epoch", epoch=1)   # stale tail the jump folds
+        assert follower.append_encoded(frame) == 4
+        assert (follower.seq, follower.base) == (4, 3)
+        assert follower.replay() == [got[0]]
+        follower.close()
     finally:
         shutil.rmtree(root, ignore_errors=True)
 
@@ -549,18 +705,29 @@ def inspect_journal(root: str) -> Dict[str, Any]:
     doc: Dict[str, Any] = {"dir": root, "records": 0, "kinds": {},
                            "last_seq": 0, "lease": None,
                            "lease_expired": None, "torn_tail_bytes": 0,
+                           "base": 0, "snapshot_seq": 0,
+                           "snapshot_age_s": None, "tail_records": 0,
                            "error": None}
     log = WriteAheadLog(root)
     try:
-        records, clean_end = log._scan()
+        records, clean_end, base = log._scan()
     except WalError as e:
         doc["error"] = f"{type(e).__name__}: {e}"
         return doc
     doc["records"] = len(records)
-    doc["last_seq"] = len(records)
+    doc["last_seq"] = base + len(records)
+    doc["base"] = base
+    doc["tail_records"] = len(records)
     kinds: Dict[str, int] = {}
-    for kind, _data in records:
+    for i, (kind, data) in enumerate(records):
         kinds[kind] = kinds.get(kind, 0) + 1
+        if kind == SNAPSHOT_KIND:
+            doc["snapshot_seq"] = base + i + 1
+            doc["tail_records"] = len(records) - i - 1
+            ts = data.get("ts")
+            if isinstance(ts, (int, float)):
+                doc["snapshot_age_s"] = round(
+                    max(0.0, time.time() - ts), 3)
     doc["kinds"] = kinds
     lease = last_lease(records)
     if lease is not None:
@@ -607,7 +774,13 @@ def _print_inspection(doc: Dict[str, Any]) -> None:
             state = ("EXPIRED" if j["lease_expired"] else "live")
             lease = (f", lease {state} "
                      f"(owner {j['lease'].get('owner')})")
-        print(f"{tag}: seq {j['last_seq']}, {kinds}{torn}{lease}")
+        snap = ""
+        if j.get("snapshot_seq"):
+            age = j.get("snapshot_age_s")
+            age_s = f", {age:.0f}s old" if age is not None else ""
+            snap = (f", snapshot at seq {j['snapshot_seq']}{age_s} "
+                    f"(+{j['tail_records']} tail records)")
+        print(f"{tag}: seq {j['last_seq']}, {kinds}{torn}{lease}{snap}")
 
     if doc["root"] is None:
         print("(no root journal)")
@@ -618,20 +791,60 @@ def _print_inspection(doc: Dict[str, Any]) -> None:
             j)
 
 
+def compact_dir(wal_dir: str, nworkers: int = 1,
+                elastic: bool = False) -> Dict[str, Any]:
+    """Offline compaction of a COLD journal (no tracker may be
+    appending): fold every record into one ``wal_snapshot/v1`` state
+    doc via the tracker's own replay fold — shared code, so offline
+    compaction can never drift from live replay semantics — and
+    rewrite the journal as snapshot-root. ``nworkers``/``elastic``
+    must match the tracker launch shape, exactly as ``--resume``
+    itself requires. Returns ``{folded, seq}``."""
+    from .tracker import fold_records   # lazy: wal must not import tracker
+    log = WriteAheadLog(wal_dir)
+    records = log.open(resume=True)
+    try:
+        state = fold_records(records, nworkers=nworkers,
+                             elastic=elastic)
+        seq, _frame = log.snapshot(state)
+    finally:
+        log.close()
+    return {"folded": len(records), "seq": seq}
+
+
 def _main(argv: Optional[List[str]] = None) -> int:
     import argparse
     import sys as _sys
     ap = argparse.ArgumentParser(
-        description="Tracker WAL tools: --smoke (CI tier 0i) or "
+        description="Tracker WAL tools: --smoke (CI tier 0i), "
                     "--inspect <dir> (per-job record counts, last "
-                    "seq, lease state, torn-tail status).")
+                    "seq, lease state, snapshot age, torn-tail "
+                    "status), or --compact <dir> (offline snapshot "
+                    "of a cold journal: replay becomes snapshot + "
+                    "tail).")
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--inspect", metavar="WAL_DIR", default=None)
+    ap.add_argument("--compact", metavar="WAL_DIR", default=None)
+    ap.add_argument("--nworkers", type=int, default=1,
+                    help="--compact: the tracker launch world size "
+                         "(folds like a resume with this shape)")
+    ap.add_argument("--elastic", action="store_true",
+                    help="--compact: fold with elastic membership on")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable --inspect output")
     args = ap.parse_args(argv)
     if args.smoke:
         _smoke()
+        return 0
+    if args.compact:
+        try:
+            out = compact_dir(args.compact, nworkers=args.nworkers,
+                              elastic=args.elastic)
+        except WalError as e:
+            print(f"compaction failed: {e}", file=_sys.stderr)
+            return 1
+        print(f"compacted {out['folded']} records into a snapshot "
+              f"at seq {out['seq']} ({args.compact})")
         return 0
     if args.inspect:
         doc = inspect_dir(args.inspect)

@@ -101,6 +101,18 @@ echo "== tier 0n: SLO plane + mini-soak (burn math -> chaos -> gate) =="
 python -m rabit_tpu.telemetry.slo --smoke
 python tools/soak.py --smoke --quiet > /tmp/rabit_soak_smoke.json
 
+echo "== tier 0o: C10k control-plane smoke (loop -> sched -> bench) =="
+# the selectors event loop echoes framed commands through the fixed
+# service pool (readiness ownership, per-key FIFO, shed-at-the-door
+# cap); the fleet scheduler's fair shares + contended sweep +
+# priority preemption run against a live multi-job tracker; then a
+# scaled-down tracker_bench ramp proves held idle connections never
+# grow the resident thread count and emits a well-formed
+# tracker_bench/v1 artifact
+python -m rabit_tpu.tracker.evloop --smoke
+python -m rabit_tpu.tracker.autoscaler --smoke
+python tools/tracker_bench.py --smoke --quiet
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

@@ -288,6 +288,69 @@ def test_chaos_smoke_entry_point():
     assert smoke() == 0
 
 
+def test_job_storm_hundreds_concurrent_bounded_pool(monkeypatch):
+    """ISSUE 19: a 300-rogue storm lands as concurrent submits through
+    a BOUNDED worker pool (never a thread per rogue), admission sheds
+    or queues every one, and the seeded per-connection RNG keeps the
+    malformed-payload pattern identical across runs no matter how the
+    pool interleaves."""
+    from rabit_tpu.chaos.proxy import _STORM_POOL_MAX, run_job_storm
+    from rabit_tpu.tracker.tracker import Tracker
+
+    monkeypatch.setenv("RABIT_MULTI_JOB", "1")
+    monkeypatch.setenv("RABIT_MAX_JOBS", "1")
+    monkeypatch.setenv("RABIT_ADMISSION_QUEUE", "2")
+    rule = Rule("job_storm", window_s=(0.0, 60.0), burst=300)
+
+    def _storm(tr):
+        from rabit_tpu.tracker import jobs as tjobs
+        # warm: the loop + fixed service pool spin up lazily; the
+        # growth being bounded is about the STORM, not tracker startup
+        assert tjobs.submit(tr.host, tr.port, "live", 2)["ok"] == 1
+        time.sleep(0.1)
+        out = {}
+        before = threading.active_count()
+        peak = [before]
+
+        def _run():
+            out["tally"] = run_job_storm(tr.host, tr.port, rule, seed=19)
+
+        t = threading.Thread(target=_run, daemon=True)
+        t.start()
+        while t.is_alive():
+            peak[0] = max(peak[0], threading.active_count())
+            time.sleep(0.002)
+        t.join()
+        return out["tally"], peak[0] - before
+
+    tr = Tracker(2).start()
+    try:
+        tally, grew = _storm(tr)
+    finally:
+        tr.stop()
+    assert grew <= _STORM_POOL_MAX + 2, grew   # +storm thread, jitter
+    assert tally["errors"] == 0, tally
+    assert tally["opened"] == 300
+    assert tally["submits"] == 150 and tally["half_open"] == 150, tally
+    assert all(isinstance(v, dict) and not v.get("ok")
+               for v in tally["verdicts"]), tally["verdicts"]
+    assert any(v.get("queued") or v.get("shed")
+               for v in tally["verdicts"]), tally["verdicts"]
+
+    # determinism under concurrency: the (seed, i)-keyed streams mean
+    # a rerun produces the same malformed/well-formed pattern even
+    # though pool interleaving differs
+    tr2 = Tracker(2).start()
+    try:
+        tally2, _ = _storm(tr2)
+    finally:
+        tr2.stop()
+    assert len(tally2["verdicts"]) == len(tally["verdicts"])
+    pat = [bool(v.get("error")) for v in tally["verdicts"]]
+    pat2 = [bool(v.get("error")) for v in tally2["verdicts"]]
+    assert pat == pat2
+
+
 # -- retry -----------------------------------------------------------------
 
 def test_backoff_delay_curve_and_jitter_bounds():

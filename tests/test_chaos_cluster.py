@@ -69,6 +69,31 @@ def test_registration_survives_tracker_blackout():
     assert stats["chaos"]["events"] >= 1, "blackout never fired"
 
 
+def test_live_job_bit_identical_through_submit_storm():
+    """ISSUE 19: hundreds of concurrent rogue submits and half-open
+    registrations hammer the tracker front for the WHOLE run while a
+    real 2-rank world bootstraps and reduces. basic_worker asserts
+    every collective's result against the analytic answer elementwise
+    (exact for the integer ops) — the storm must not perturb a single
+    bit of the live job's schedule or payloads — and admission must
+    have shed or queued every rogue rather than stalling the world."""
+    chaos = {"seed": 19, "rules": [
+        {"kind": "job_storm", "window_s": [0.0, 120.0], "burst": 300,
+         "target": "tracker"}]}
+    rc, stats = run_cluster(2, "basic_worker.py", chaos=chaos,
+                            env={"RABIT_MULTI_JOB": "1",
+                                 "RABIT_MAX_JOBS": "1",
+                                 "RABIT_ADMISSION_QUEUE": "2"})
+    assert rc == 0
+    assert stats["chaos"]["events"] >= 1, "storm never fired"
+    assert stats["chaos"]["storm_submits"] >= 100, stats["chaos"]
+    # the live world runs in the default job (no RABIT_MAX_JOBS slot),
+    # so at most ONE rogue wins the single free slot; admission must
+    # refuse (queue/shed/error) every other concurrent submit
+    assert stats["chaos"]["storm_submits"] - \
+        stats["chaos"]["storm_shed"] <= 1, stats["chaos"]
+
+
 def test_collectives_survive_link_resets():
     """Each link proxy hard-resets its first connection once enough
     bytes passed — mid-collective RSTs on live recovery-capable

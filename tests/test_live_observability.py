@@ -238,6 +238,47 @@ def test_tracker_live_plane_polls_and_names_straggler(monkeypatch):
         srv1.stop()
 
 
+def test_tracker_c10k_gauges_in_exposition_and_capture(tmp_path,
+                                                       monkeypatch):
+    """ISSUE 19: the event-loop/WAL/scheduler gauges ride the tracker's
+    /metrics exposition and surface as first-class fields in
+    ``capture_status --live``."""
+    monkeypatch.setenv("RABIT_MULTI_JOB", "1")
+    tr = Tracker(2, metrics_port=0, wal_dir=str(tmp_path / "wal"),
+                 multi_job=True).start()
+    try:
+        # a held-open connection the loop must be holding right now
+        idle = socket.create_connection((tr.host, tr.port), timeout=10)
+        deadline = time.monotonic() + 10
+        while tr._loop.open_conns < 1:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        host, port = tr.live_stats()["metrics_addr"]
+        _, text = _get(host, port, "/metrics")
+        for fam in ("rabit_tracker_open_conns",
+                    "rabit_tracker_loop_lag_ms",
+                    "rabit_wal_snapshot_seq",
+                    "rabit_sched_preemptions_total"):
+            assert f"# TYPE {fam}" in text, fam
+        assert "rabit_wal_snapshot_seq 0" in text  # no snapshot yet
+        idle.close()
+
+        import importlib.util as _ilu
+        spec = _ilu.spec_from_file_location(
+            "capture_status", os.path.join(ROOT, "tools",
+                                           "capture_status.py"))
+        cap = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(cap)
+        doc, ok = cap.live_status(f"{host}:{port}")
+        assert ok, doc
+        assert doc["open_conns"] >= 0
+        assert doc["wal_snapshot_seq"] == 0
+        assert doc["sched_preemptions_total"] == 0
+        assert "loop_lag_ms" in doc
+    finally:
+        tr.stop()
+
+
 def test_tracker_without_metrics_port_stays_dark():
     tr = Tracker(1).start()
     try:

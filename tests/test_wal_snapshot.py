@@ -1,0 +1,331 @@
+"""WAL snapshot compaction (ISSUE 19): offline --compact equivalence
+against a full replay, live snapshot trigger + resume, replication
+across a compaction (snapshot frame seq jump), and promotion of a
+standby whose replicated journal contains a snapshot."""
+
+import json
+import shutil
+import socket
+import struct
+import time
+
+import pytest
+
+from rabit_tpu.tracker import jobs as jobs_mod
+from rabit_tpu.tracker import wal as wal_mod
+from rabit_tpu.tracker.standby import StandbyTracker
+from rabit_tpu.tracker.tracker import (
+    MAGIC as WIRE_MAGIC, Tracker, fold_records, snapshot_state)
+from rabit_tpu.tracker.wal import SNAPSHOT_KIND, WriteAheadLog
+
+
+# --------------------------------------------------------------- helpers
+
+def _send_u32(s, v):
+    s.sendall(struct.pack("<I", v))
+
+
+def _send_str(s, txt):
+    b = txt.encode()
+    _send_u32(s, len(b))
+    s.sendall(b)
+
+
+def _recv_all(s, n):
+    out = b""
+    while len(out) < n:
+        chunk = s.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("closed")
+        out += chunk
+    return out
+
+
+def _wire_cmd(tr, cmd, task_id="0", payload=None):
+    """One raw tracker command round-trip; returns the u32 reply."""
+    c = socket.create_connection((tr.host, tr.port), timeout=10)
+    _send_u32(c, WIRE_MAGIC)
+    _send_str(c, cmd)
+    _send_str(c, task_id)
+    _send_u32(c, 0)
+    if payload is not None:
+        _send_str(c, payload)
+    out = struct.unpack("<I", _recv_all(c, 4))[0]
+    c.close()
+    return out
+
+
+def _endpoint(tr, task, port):
+    assert _wire_cmd(tr, "endpoint", task, json.dumps(
+        {"host": "127.0.0.1", "port": int(port),
+         "rank": int(task.rsplit("/", 1)[-1])})) == 1
+
+
+def _form(tr, tasks):
+    conns = [jobs_mod.wire_register(tr.host, tr.port, t) for t in tasks]
+    return sorted(jobs_mod.wire_read_assignment(c) for c in conns)
+
+
+def _resume(dead, root, **kw):
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            return Tracker(dead.nworkers, host=dead.host, port=dead.port,
+                           wal_dir=root, resume=True, **kw)
+        except OSError:
+            assert time.monotonic() < deadline, "port never freed"
+            time.sleep(0.05)
+
+
+def _busy_tracker(root, monkeypatch):
+    """A multi-job elastic tracker with real history: two formed
+    worlds, an eviction, endpoint announces, a closed job."""
+    monkeypatch.setenv("RABIT_MULTI_JOB", "1")
+    monkeypatch.setenv("RABIT_ELASTIC", "1")
+    tr = Tracker(2, wal_dir=root, elastic=True, multi_job=True).start()
+    assert jobs_mod.submit(tr.host, tr.port, "jobA", 2,
+                           elastic=True)["ok"] == 1
+    assert jobs_mod.submit(tr.host, tr.port, "jobB", 1)["ok"] == 1
+    assert _form(tr, ["jobA/0", "jobA/1"]) == [(0, 2, 1), (1, 2, 1)]
+    assert _form(tr, ["jobB/0"]) == [(0, 1, 1)]
+    _endpoint(tr, "jobA/0", 9100)
+    _endpoint(tr, "jobA/1", 9101)
+    assert _wire_cmd(tr, "evict", "jobA/x", json.dumps(
+        {"rank": 1, "reason": "test"})) == 1
+    jobs_mod.wire_shutdown(tr.host, tr.port, "jobB/0")
+    deadline = time.monotonic() + 10
+    while tr.job("jobB").open:
+        assert time.monotonic() < deadline, "jobB never closed"
+        time.sleep(0.02)
+    return tr
+
+
+# ----------------------------------------------- offline --compact
+
+
+def test_offline_compaction_replays_to_same_state(tmp_path, monkeypatch):
+    """THE acceptance bar: snapshot + tail replays to the same tracker
+    state as the full journal (fingerprinted via snapshot_state)."""
+    root_a = str(tmp_path / "full")
+    tr = _busy_tracker(root_a, monkeypatch)
+    tr.crash()
+    root_b = str(tmp_path / "compacted")
+    shutil.copytree(root_a, root_b)
+
+    out = wal_mod.compact_dir(root_b, nworkers=2, elastic=True)
+    assert out["folded"] > 5 and out["seq"] == out["folded"] + 1
+    log = WriteAheadLog(root_b)
+    records = log.open(resume=True)
+    log.close()
+    assert records[0][0] == SNAPSHOT_KIND and len(records) == 1
+    assert log.base == out["folded"]
+
+    full = _resume(tr, root_a, multi_job=True, elastic=True)
+    full.start()
+    try:
+        snap = Tracker(2, wal_dir=root_b, resume=True,
+                       multi_job=True, elastic=True).start()
+        try:
+            with full._lock, snap._lock:
+                a, b = snapshot_state(full), snapshot_state(snap)
+            assert a == b
+            # and the state is the real history, not vacuously empty
+            assert a["jobs"]["jobA"]["member"]["evicted"] == [1]
+            assert a["jobs"]["jobA"]["endpoints"]["1"]["port"] == 9101
+            assert a["jobs"]["jobB"]["closed"] is True
+            assert snap.job("jobA")._epoch == full.job("jobA")._epoch == 1
+        finally:
+            snap.stop()
+    finally:
+        full.stop()
+
+
+def test_fold_records_matches_wal_replay(tmp_path, monkeypatch):
+    """fold_records over the raw journal equals the live tracker's own
+    serialized state at crash time (write-ahead: the journal IS the
+    state)."""
+    root = str(tmp_path / "wal")
+    tr = _busy_tracker(root, monkeypatch)
+    with tr._lock:
+        live = snapshot_state(tr)
+    tr.crash()
+    folded = fold_records(WriteAheadLog(root).replay(),
+                          nworkers=2, elastic=True)
+    assert folded == live
+
+
+# ------------------------------------------------- live snapshots
+
+
+def test_live_snapshot_trigger_resume_and_inspect(tmp_path, monkeypatch):
+    """rabit_wal_snapshot_every compacts a LIVE journal: the root is
+    rewritten as snapshot + tail, --inspect reports it, and a crash ->
+    resume replays the compacted journal to the same world."""
+    monkeypatch.setenv("RABIT_WAL_SNAPSHOT_EVERY", "6")
+    root = str(tmp_path / "wal")
+    tr = Tracker(2, wal_dir=root).start()
+    try:
+        assert _form(tr, ["0", "1"]) == [(0, 2, 1), (1, 2, 1)]
+        for i in range(8):
+            _endpoint(tr, "0", 9200 + i)
+        deadline = time.monotonic() + 10
+        while tr.snapshot_seq() == 0:
+            assert time.monotonic() < deadline, "never snapshotted"
+            time.sleep(0.02)
+        doc = wal_mod.inspect_journal(root)
+        assert doc["snapshot_seq"] == tr.snapshot_seq()
+        assert doc["base"] == doc["snapshot_seq"] - 1
+        assert doc["snapshot_age_s"] is not None
+        assert doc["last_seq"] >= doc["snapshot_seq"]
+        with tr._lock:
+            live = snapshot_state(tr)
+        tr.crash()
+        res = _resume(tr, root)
+        res.start()
+        try:
+            assert res._ranks == {"0": 0, "1": 1}
+            assert res._epoch == 1 and res.restarts == 1
+            with res._lock:
+                got = snapshot_state(res)
+            got["restarts"] = live["restarts"]  # resume bumped it
+            assert got == live
+        finally:
+            res.stop()
+    finally:
+        tr.stop()
+
+
+def test_snapshot_off_by_default(tmp_path):
+    """Knob unset: no snapshot records, byte-identical journal plane."""
+    root = str(tmp_path / "wal")
+    tr = Tracker(2, wal_dir=root).start()
+    try:
+        assert _form(tr, ["0", "1"]) == [(0, 2, 1), (1, 2, 1)]
+        assert tr.snapshot_seq() == 0
+    finally:
+        tr.stop()
+    assert all(k != SNAPSHOT_KIND
+               for k, _d in WriteAheadLog(root).replay())
+
+
+# -------------------------------------------- replication + promotion
+
+
+def test_promotion_through_live_snapshot(tmp_path, monkeypatch):
+    """A standby that replicated a mid-stream snapshot frame promotes
+    to the same world: snapshot + tail rides the repl stream in-order
+    and replays through Tracker(resume=True) at promotion."""
+    monkeypatch.setenv("RABIT_WAL_SNAPSHOT_EVERY", "5")
+    lease_ms = 400
+    tr = sb = None
+    try:
+        tr = Tracker(2, wal_dir=str(tmp_path / "leader"),
+                     lease_ms=lease_ms).start()
+        sb = StandbyTracker(tr.host, tr.port, 2,
+                            wal_dir=str(tmp_path / "standby"),
+                            lease_ms=lease_ms, quiet=True).start()
+        assert _form(tr, ["0", "1"]) == [(0, 2, 1), (1, 2, 1)]
+        for i in range(6):
+            _endpoint(tr, "1", 9300 + i)
+        deadline = time.monotonic() + 10
+        while tr.snapshot_seq() == 0:
+            assert time.monotonic() < deadline, "never snapshotted"
+            time.sleep(0.02)
+        _endpoint(tr, "0", 9400)   # a tail record PAST the snapshot
+        deadline = time.monotonic() + 10
+        while sb.acked_seq < tr.repl_stats()["seq"]:
+            assert time.monotonic() < deadline, "replication lagged"
+            time.sleep(0.02)
+        with tr._lock:
+            live = snapshot_state(tr)
+        tr.crash()
+        t0 = time.monotonic()
+        while not sb.promoted():
+            assert time.monotonic() - t0 < 10, "standby never promoted"
+            time.sleep(0.02)
+        res = sb.tracker
+        assert res._ranks == {"0": 0, "1": 1} and res._epoch == 1
+        assert res._endpoints["0"]["port"] == 9400
+        assert res._endpoints["1"]["port"] == 9305
+        with res._lock:
+            got = snapshot_state(res)
+        # promotion stamps restarts/lease/failover on top of the
+        # replicated history; the journaled world must match exactly
+        assert got["jobs"] == live["jobs"]
+    finally:
+        if sb is not None:
+            sb.stop()
+        if tr is not None:
+            tr.stop()
+
+
+def test_follower_resync_across_precompacted_leader(tmp_path,
+                                                    monkeypatch):
+    """A leader RESUMED from a compacted journal (base > 0) serves a
+    fresh follower the snapshot root first; the follower's journal
+    adopts the seq jump and promotion replays snapshot + tail."""
+    lease_ms = 400
+    root = str(tmp_path / "leader")
+    tr = Tracker(2, wal_dir=root).start()
+    assert _form(tr, ["0", "1"]) == [(0, 2, 1), (1, 2, 1)]
+    _endpoint(tr, "0", 9500)
+    tr.stop()
+    wal_mod.compact_dir(root, nworkers=2)
+    res = sb = None
+    try:
+        res = _resume(tr, root, lease_ms=lease_ms)
+        res.start()
+        assert res._repl_base > 0
+        sb = StandbyTracker(res.host, res.port, 2,
+                            wal_dir=str(tmp_path / "standby"),
+                            lease_ms=lease_ms, quiet=True).start()
+        _endpoint(res, "1", 9501)   # post-compaction tail record
+        deadline = time.monotonic() + 10
+        while sb.acked_seq < res.repl_stats()["seq"]:
+            assert time.monotonic() < deadline, "replication lagged"
+            time.sleep(0.02)
+        assert sb.acked_seq > res._repl_base
+        res.crash()
+        t0 = time.monotonic()
+        while not sb.promoted():
+            assert time.monotonic() - t0 < 10, "standby never promoted"
+            time.sleep(0.02)
+        prom = sb.tracker
+        assert prom._ranks == {"0": 0, "1": 1} and prom._epoch == 1
+        assert prom._endpoints["0"]["port"] == 9500   # from the snapshot
+        assert prom._endpoints["1"]["port"] == 9501   # from the tail
+    finally:
+        if sb is not None:
+            sb.stop()
+        (res or tr).stop()
+
+
+# ----------------------------------------------------- CLI surface
+
+
+def test_wal_cli_compact_and_inspect(tmp_path, capsys):
+    root = str(tmp_path / "wal")
+    w = WriteAheadLog(root)
+    w.open()
+    w.record("assign", task="0", rank=0)
+    w.record("epoch", epoch=1)
+    w.close()
+    assert wal_mod._main(["--compact", root, "--nworkers", "2"]) == 0
+    out = capsys.readouterr().out
+    assert "compacted 2 records into a snapshot at seq 3" in out
+    assert wal_mod._main(["--inspect", root]) == 0
+    out = capsys.readouterr().out
+    assert "snapshot at seq 3" in out and "+0 tail records" in out
+    doc = wal_mod.inspect_journal(root)
+    assert doc["base"] == 2 and doc["snapshot_seq"] == 3
+    assert doc["tail_records"] == 0
+    # the folded state carries the journaled rank
+    records = WriteAheadLog(root).replay()
+    state = records[0][1]["state"]
+    assert state["jobs"]["default"]["ranks"] == {"0": 0}
+    assert state["jobs"]["default"]["epoch"] == 1
+
+
+def test_compact_dir_refuses_missing_journal(tmp_path):
+    with pytest.raises(wal_mod.WalError):
+        wal_mod.compact_dir(str(tmp_path / "nope"))

@@ -167,6 +167,23 @@ def live_status(target):
             except (ValueError, IndexError):
                 pass
     doc["collectives_total"] = collectives
+    # C10k control-plane gauges (ISSUE 19): surfaced as first-class
+    # fields when the target is a tracker endpoint (rank endpoints
+    # simply lack the families and the keys stay absent)
+    for fam, key in (("rabit_tracker_open_conns", "open_conns"),
+                     ("rabit_tracker_loop_lag_ms", "loop_lag_ms"),
+                     ("rabit_wal_snapshot_seq", "wal_snapshot_seq"),
+                     ("rabit_sched_preemptions_total",
+                      "sched_preemptions_total")):
+        total = None
+        for ln in samples:
+            if ln.startswith(fam + " ") or ln.startswith(fam + "{"):
+                try:
+                    total = (total or 0.0) + float(ln.rsplit(None, 1)[1])
+                except (ValueError, IndexError):
+                    pass
+        if total is not None:
+            doc[key] = total
     # /straggler is a tracker-only route; rank endpoints 404 and the
     # field is simply absent (scrape health is judged without it)
     try:

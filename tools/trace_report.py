@@ -319,8 +319,38 @@ def render_soak(doc):
     return out
 
 
+def render_tracker_bench(doc):
+    """tracker_bench/v1: the C10k ladder — per idle-conn rung, world
+    formation throughput, command latency, and the boundedness
+    evidence (resident threads + fds must not scale with the rung)."""
+    rows = []
+    for lv in doc.get("levels", []):
+        rows.append((
+            str(lv.get("idle_conns", "?")),
+            f"{lv.get('regs_per_s', 0):g}",
+            f"{lv.get('cmd_p50_ms', 0):g}",
+            f"{lv.get('cmd_p99_ms', 0):g}",
+            str(lv.get("threads", "?")),
+            str(lv.get("fds", "?")),
+            f"{lv.get('loop_lag_ms', 0):g}"))
+    base = doc.get("baseline", {})
+    title = (f"Tracker C10k bench — up to "
+             f"{doc.get('max_idle_conns', '?')} idle conns, threads "
+             f"{'bounded' if doc.get('bounded_threads') else 'UNBOUNDED'}"
+             f" ({doc.get('timestamp_utc', '')})")
+    out = title + "\n\n" + _md_table(
+        ("idle conns", "regs/s", "cmd p50 ms", "cmd p99 ms",
+         "threads", "fds", "loop lag ms"), rows)
+    out += (f"\n\nBaseline before the ladder: "
+            f"{base.get('threads', '?')} threads, "
+            f"{base.get('fds', '?')} fds; {doc.get('waves', '?')} "
+            f"formation waves x {doc.get('nworkers', '?')} workers and "
+            f"{doc.get('cmd_samples', '?')} latency samples per rung")
+    return out
+
+
 _KINDS = ("telemetry_summary", "telemetry_fleet", "telemetry_trace",
-          "flight_record", "bench_sentinel", "soak")
+          "flight_record", "bench_sentinel", "soak", "tracker_bench")
 
 
 def recognized(doc):
@@ -343,6 +373,8 @@ def render(doc):
         return render_sentinel(doc)
     if matches(doc, "soak"):
         return render_soak(doc)
+    if matches(doc, "tracker_bench"):
+        return render_tracker_bench(doc)
     if doc.get("schema") in ("rabit_tpu.collective_sweep/v1",
                              "rabit_tpu.collective_sweep/v2"):
         return render_sweep(doc)

@@ -1,0 +1,334 @@
+#!/usr/bin/env python
+"""C10k control-plane benchmark for the selectors-based tracker
+(ISSUE 19): how many IDLE worker connections one tracker holds, and
+what registration throughput + command latency look like while it
+holds them.
+
+The event-loop rewrite's whole claim is that an idle connection costs
+a file descriptor and a buffer, not a thread. This tool measures that
+claim directly: it ramps a ladder of held-open idle connections
+(default 1k / 5k / 10k) against an in-process tracker and, AT EACH
+RUNG, measures
+
+- ``regs_per_s``   — full world formations driven through the real
+  registration wire protocol (register + assignment read), workers/s;
+- ``cmd_p50_ms`` / ``cmd_p99_ms`` — round-trip latency of the cheap
+  ``world`` command, sampled serially;
+- ``threads``      — ``threading.active_count()`` of the tracker
+  process (the boundedness proof: it must NOT scale with the rung);
+- ``fds``          — the tracker process's open descriptor count;
+- ``open_conns`` / ``loop_lag_ms`` — the loop's own gauges.
+
+Idle connections are held by CHILD processes (``--hold`` mode), one
+per ladder delta, so the tracker process's RLIMIT_NOFILE budget is
+spent on its own half of each socket pair — exactly like real remote
+workers — and a 10k rung fits under a 20k fd limit.
+
+Emits a schema-versioned ``rabit_tpu.tracker_bench/v1`` artifact,
+appends per-rung series into ``benchmarks/history.jsonl``
+(``rabit_tpu/telemetry/history.py``; ``tools/bench_sentinel.py``
+gates them), and is rendered by ``tools/trace_report.py``.
+
+    python tools/tracker_bench.py --out TRACKER_BENCH.json
+    python tools/tracker_bench.py --smoke     # CI tier: tiny ladder
+"""
+
+import argparse
+import json
+import os
+import resource
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from rabit_tpu.telemetry import history  # noqa: E402
+from rabit_tpu.telemetry.schema import make_header, matches  # noqa: E402
+from rabit_tpu.tracker import jobs as jobs_mod  # noqa: E402
+from rabit_tpu.tracker.tracker import MAGIC, Tracker  # noqa: E402
+
+BENCH_KIND = "tracker_bench"
+LEVELS_DEFAULT = (1000, 5000, 10000)
+# the boundedness bar: between the 0-conn rung and the top rung the
+# tracker may start at most this many more threads (a lazily-spawned
+# fixed helper, a repl streamer) — never a per-connection thread
+THREAD_SLACK = 4
+
+
+def _fd_count() -> int:
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:  # non-procfs platform: count soft-limit probes
+        n = 0
+        for fd in range(resource.getrlimit(resource.RLIMIT_NOFILE)[0]):
+            try:
+                os.fstat(fd)
+                n += 1
+            except OSError:
+                pass
+        return n
+
+
+def _raise_nofile() -> int:
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        except (ValueError, OSError):
+            pass
+    return soft
+
+
+# ------------------------------------------------------------ measurement
+
+
+def _cmd_rtt_ms(host: str, port: int) -> float:
+    """One serial ``world`` command round-trip (connect included —
+    that IS the worker's experience of control-plane latency)."""
+    t0 = time.monotonic()
+    c = socket.create_connection((host, port), timeout=30)
+    try:
+        c.sendall(struct.pack("<I", MAGIC))
+        for txt in ("world", "bench"):
+            b = txt.encode()
+            c.sendall(struct.pack("<I", len(b)) + b)
+        c.sendall(struct.pack("<I", 0))
+        (n,) = struct.unpack("<I", _recv_all(c, 4))
+        _recv_all(c, n)
+    finally:
+        c.close()
+    return (time.monotonic() - t0) * 1e3
+
+
+def _recv_all(s: socket.socket, n: int) -> bytes:
+    out = b""
+    while len(out) < n:
+        chunk = s.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("tracker closed mid-reply")
+        out += chunk
+    return out
+
+
+def _reg_waves(tr, waves: int) -> float:
+    """``waves`` full world formations through the real registration
+    protocol; returns registrations per second."""
+    t0 = time.monotonic()
+    for _ in range(waves):
+        conns = [jobs_mod.wire_register(tr.host, tr.port, str(i))
+                 for i in range(tr.nworkers)]
+        for c in conns:
+            jobs_mod.wire_read_assignment(c)
+        for c in conns:
+            c.close()
+    dt = time.monotonic() - t0
+    return (waves * tr.nworkers) / dt if dt > 0 else 0.0
+
+
+def _percentile(xs, q: float) -> float:
+    s = sorted(xs)
+    if not s:
+        return 0.0
+    i = min(len(s) - 1, max(0, int(round(q * (len(s) - 1)))))
+    return s[i]
+
+
+def _measure(tr, waves: int, samples: int) -> dict:
+    regs = _reg_waves(tr, waves)
+    rtts = [_cmd_rtt_ms(tr.host, tr.port) for _ in range(samples)]
+    return {
+        "regs_per_s": round(regs, 1),
+        "cmd_p50_ms": round(_percentile(rtts, 0.50), 3),
+        "cmd_p99_ms": round(_percentile(rtts, 0.99), 3),
+        "threads": threading.active_count(),
+        "fds": _fd_count(),
+        "open_conns": tr._loop.open_conns,
+        "loop_lag_ms": round(tr._loop.lag_ms(), 4),
+    }
+
+
+# ------------------------------------------------------------ idle holders
+
+
+def _hold_main(host: str, port: int, n: int) -> int:
+    """Child mode: open ``n`` idle connections, report, then hold them
+    until the parent closes our stdin. Connects are paced so the
+    tracker's SYN backlog (256) never overflows into retry stalls."""
+    _raise_nofile()
+    socks = []
+    deadline = time.monotonic() + 120
+    while len(socks) < n:
+        try:
+            socks.append(socket.create_connection((host, port),
+                                                  timeout=30))
+        except OSError:
+            if time.monotonic() > deadline:
+                print(f"held {len(socks)}", flush=True)
+                return 1
+            time.sleep(0.05)
+            continue
+        if len(socks) % 200 == 0:
+            time.sleep(0.02)
+    print(f"held {len(socks)}", flush=True)
+    sys.stdin.read()   # parent hangs up -> release
+    for s in socks:
+        try:
+            s.close()
+        except OSError:
+            pass
+    return 0
+
+
+class _Holder:
+    """One child process holding ``n`` idle connections open."""
+
+    def __init__(self, host: str, port: int, n: int):
+        self.n = n
+        self.proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--hold",
+             host, str(port), str(n)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, text=True)
+        line = self.proc.stdout.readline().strip()
+        self.held = int(line.split()[1]) if line.startswith("held") else 0
+
+    def release(self) -> None:
+        try:
+            self.proc.stdin.close()
+        except OSError:
+            pass
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+
+
+# ------------------------------------------------------------------- run
+
+
+def run_bench(levels, nworkers: int, waves: int, samples: int,
+              quiet: bool = False) -> dict:
+    """Ramp the idle-connection ladder; returns the
+    ``rabit_tpu.tracker_bench/v1`` artifact."""
+    _raise_nofile()
+    tr = Tracker(nworkers).start()
+    holders = []
+    try:
+        doc = make_header(BENCH_KIND)
+        doc["nworkers"] = nworkers
+        doc["waves"] = waves
+        doc["cmd_samples"] = samples
+        doc["baseline"] = {"threads": threading.active_count(),
+                           "fds": _fd_count()}
+        doc["levels"] = []
+        held = 0
+        for target in [0] + sorted(levels):
+            delta = target - held
+            if delta > 0:
+                h = _Holder(tr.host, tr.port, delta)
+                holders.append(h)
+                held += h.held
+                # wait for the loop to drain its accept backlog
+                deadline = time.monotonic() + 60
+                while tr._loop.open_conns < held \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.05)
+            m = _measure(tr, waves, samples)
+            m["idle_conns"] = held
+            doc["levels"].append(m)
+            if not quiet:
+                print(f"[tracker_bench] {held} idle conns: "
+                      f"{m['regs_per_s']:g} regs/s, "
+                      f"p99 {m['cmd_p99_ms']:g} ms, "
+                      f"{m['threads']} threads, {m['fds']} fds",
+                      file=sys.stderr, flush=True)
+        top = doc["levels"][-1]
+        doc["max_idle_conns"] = top["idle_conns"]
+        # the C10k claim: thread count at the top rung equals the
+        # 0-conn rung (measured after the fixed pools lazily started)
+        doc["bounded_threads"] = (
+            top["threads"] <= doc["levels"][0]["threads"] + THREAD_SLACK)
+        return doc
+    finally:
+        for h in holders:
+            h.release()
+        tr.stop()
+
+
+def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv[:1] == ["--hold"]:
+        return _hold_main(argv[1], int(argv[2]), int(argv[3]))
+    ap = argparse.ArgumentParser(
+        description="C10k tracker benchmark: idle-connection ladder "
+                    "with per-rung throughput/latency/thread/fd counts")
+    ap.add_argument("--levels", default=None,
+                    help="comma-separated idle-conn rungs "
+                         "(default 1000,5000,10000)")
+    ap.add_argument("--nworkers", type=int, default=2,
+                    help="world size per registration wave")
+    ap.add_argument("--waves", type=int, default=50,
+                    help="world formations per rung")
+    ap.add_argument("--samples", type=int, default=200,
+                    help="command-latency samples per rung")
+    ap.add_argument("--out", default=None,
+                    help="write the tracker_bench/v1 artifact here")
+    ap.add_argument("--history", default=history.history_path(REPO),
+                    help="history JSONL to trend into (non-smoke)")
+    ap.add_argument("--no-history", action="store_true")
+    ap.add_argument("--quiet", action="store_true")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny ladder (CI tier 0o): asserts the "
+                         "artifact shape and thread boundedness")
+    args = ap.parse_args(argv)
+
+    levels = LEVELS_DEFAULT
+    if args.levels:
+        levels = tuple(int(x) for x in args.levels.split(",") if x)
+    waves, samples = args.waves, args.samples
+    if args.smoke:
+        if args.levels is None:
+            levels = (50, 150)
+        waves = min(waves, 10)
+        samples = min(samples, 40)
+
+    doc = run_bench(levels, args.nworkers, waves, samples,
+                    quiet=args.quiet)
+    doc["smoke"] = bool(args.smoke)
+
+    if args.smoke:
+        # the artifact contract, asserted where CI can see it
+        assert matches(doc, BENCH_KIND), doc.get("schema")
+        assert len(doc["levels"]) == len(levels) + 1, doc["levels"]
+        top = doc["levels"][-1]
+        assert top["idle_conns"] >= max(levels), top
+        assert top["open_conns"] >= max(levels), top
+        assert doc["bounded_threads"], (doc["baseline"], top)
+        for m in doc["levels"]:
+            assert m["regs_per_s"] > 0 and m["cmd_p99_ms"] > 0, m
+        print("tracker_bench smoke ok", file=sys.stderr)
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(doc, f, indent=2, sort_keys=True)
+            f.write("\n")
+    print(json.dumps(doc, sort_keys=True))
+    if not args.smoke and not args.no_history:
+        added = history.append(
+            args.history, history.records_from_artifact(
+                doc, source=os.path.basename(args.out or "tracker_bench")))
+        print(f"[tracker_bench] trended {added} records into "
+              f"{args.history}", file=sys.stderr)
+    return 0 if doc["bounded_threads"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
