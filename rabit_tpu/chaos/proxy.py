@@ -350,11 +350,18 @@ class ChaosProxy:
         # much chaos a run actually absorbed) and a flight-recorder
         # breadcrumb (crash bundles show what was injected just before)
         from .. import telemetry
-        from ..telemetry import flight
+        from ..telemetry import events, flight
         telemetry.count(f"chaos.{kind}", op=self.name, provenance="chaos")
         flight.note(f"chaos.{kind}",
                     f"{self.name} conn#{conn_index} -> "
                     f"{self.upstream[0]}:{self.upstream[1]}")
+        # fleet event bus: the injection lands HLC-stamped in the
+        # causal record, so the incident engine can attribute the
+        # recovery rungs and SLO burns that follow it (the rule kind
+        # maps onto the registered chaos.<kind> namespace)
+        events.emit_chaos(kind,
+                          f"{self.name} conn#{conn_index} -> "
+                          f"{self.upstream[0]}:{self.upstream[1]}")
         print(f"[{self.name}] t={self.elapsed():.2f}s inject {kind} "
               f"conn#{conn_index} -> {self.upstream[0]}:{self.upstream[1]}",
               file=sys.stderr, flush=True)

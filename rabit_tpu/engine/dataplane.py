@@ -236,6 +236,10 @@ class XlaDataPlane:
         if was_formed:
             telemetry.count("recovery.epoch_advance",
                             provenance="recovery")
+            from ..telemetry import events
+            events.emit("recovery.epoch_advance",
+                        f"rank {self._rank} re-forming at epoch {epoch}",
+                        rank=self._rank)
         self._teardown()
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
         self._rank = int(self._lib.RbtGetRank())
@@ -364,12 +368,17 @@ class XlaDataPlane:
                     self.retries_total += 1
                     telemetry.count("recovery.retry", op="dataplane",
                                     provenance="recovery")
-                    from ..telemetry import flight
+                    from ..telemetry import events, flight
                     flight.note(
                         "recovery.retry",
                         f"rank {self._rank} round {round_id} attempt "
                         f"{attempt}/{self._retries}: "
                         f"{type(e).__name__}: {e}")
+                    events.emit(
+                        "recovery.retry",
+                        f"rank {self._rank} round {round_id} attempt "
+                        f"{attempt}/{self._retries}: {type(e).__name__}",
+                        rank=self._rank)
                     print(f"[dataplane] rank {self._rank} round {round_id} "
                           f"retry {attempt}/{self._retries} after "
                           f"{type(e).__name__}: {e}",
@@ -392,10 +401,13 @@ class XlaDataPlane:
                 # collectives escalated past the retry rung
                 telemetry.count("recovery.link_reset", op="dataplane",
                                 provenance="recovery")
-                from ..telemetry import flight
+                from ..telemetry import events, flight
                 flight.note("link_reset",
                             f"rank {self._rank} epoch {epoch}: "
                             f"{type(e).__name__}: {e}")
+                events.emit("recovery.link_reset",
+                            f"rank {self._rank} epoch {epoch}: "
+                            f"{type(e).__name__}", rank=self._rank)
                 try:
                     self._teardown()
                 except Exception:  # pragma: no cover - best-effort

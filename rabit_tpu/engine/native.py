@@ -398,6 +398,10 @@ class NativeEngine(Engine):
         unreachable from here; the reform rung handles those."""
         telemetry.count("recovery.retry", op="watchdog_rung",
                         provenance="recovery")
+        from ..telemetry import events
+        events.emit("recovery.retry", "watchdog retry rung: device "
+                    "world torn down for in-collective replay",
+                    rank=self.rank)
         dp = self._dataplane
         if dp is not None and dp.formed:
             dp.shutdown()
@@ -411,6 +415,10 @@ class NativeEngine(Engine):
         without process exit. Safe from the monitor thread."""
         telemetry.count("recovery.world_reform", op="watchdog_rung",
                         provenance="recovery")
+        from ..telemetry import events
+        events.emit("recovery.world_reform",
+                    "watchdog reform rung: out-of-band interrupt into "
+                    "global re-formation", rank=self.rank)
         if hasattr(self._lib, "RbtInterruptEx"):
             self._lib.RbtInterruptEx(b"watchdog_reform")
         else:
@@ -433,11 +441,29 @@ class NativeEngine(Engine):
         names = ("recovery.retry", "recovery.frame_reject",
                  "recovery.link_resurrect")
         ops = ("native_round", "frame_crc", "link")
+        from ..telemetry import events
         for name, op, c, p in zip(names, ops, cur, prev):
             # counters are monotonic; cap the replay so a missed drain
             # after thousands of events cannot stall the caller
-            for _ in range(min(max(0, c - p), 1000)):
+            delta = min(max(0, c - p), 1000)
+            for _ in range(delta):
                 telemetry.count(name, op=op, provenance="recovery")
+            if delta:
+                # one fleet event per drained kind (not per count):
+                # the bus carries the causal marker, the counters
+                # carry the magnitude
+                if name == "recovery.retry":
+                    events.emit("recovery.retry",
+                                f"native in-collective retries ×{delta}",
+                                rank=self.rank, count=delta)
+                elif name == "recovery.frame_reject":
+                    events.emit("recovery.frame_reject",
+                                f"frame CRC rejects ×{delta}",
+                                rank=self.rank, count=delta)
+                else:
+                    events.emit("recovery.link_resurrect",
+                                f"link resurrections ×{delta}",
+                                rank=self.rank, count=delta)
 
     def set_world_reformed_callback(self, fn) -> None:
         """``fn(epoch)`` fires after each device-world re-formation; use
@@ -475,6 +501,9 @@ class NativeEngine(Engine):
         telemetry.record_span("membership.transition", 0.0, op="resize",
                               provenance="membership", world=world)
         _fl.note("member_resize", f"world resized to {world}")
+        from ..telemetry import events
+        events.emit("membership.epoch_reset",
+                    f"world resized to {world}", rank=self.rank)
 
     def shutdown(self) -> None:
         if self._metrics_server is not None:
@@ -659,6 +688,10 @@ class NativeEngine(Engine):
         self._seed_native(maxv, g, local)
         telemetry.count("recovery.cold_restart", nbytes=len(g),
                         provenance="recovery")
+        from ..telemetry import events
+        events.emit("recovery.cold_restart",
+                    f"resumed at checkpoint version {maxv} "
+                    f"(holder rank {root})", rank=self.rank)
         log.log_warn("cold restart: resumed at checkpoint version %d "
                      "(holder rank %d)", maxv, root)
         return (maxv, g, local)

@@ -246,6 +246,9 @@ class XlaEngine(Engine):
                               provenance="membership", old_world=old,
                               world=world)
         _fl.note("member_resize", f"world {old} -> {world}")
+        from ..telemetry import events
+        events.emit("membership.epoch_reset",
+                    f"world {old} -> {world}", rank=self._rank)
 
     def shutdown(self) -> None:
         try:
@@ -591,6 +594,10 @@ class XlaEngine(Engine):
             self._local = (got[1] or None) if got is not None else None
         telemetry.count("recovery.cold_restart",
                         nbytes=len(self._global), provenance="recovery")
+        from ..telemetry import events
+        events.emit("recovery.cold_restart",
+                    f"resumed at checkpoint version {maxv} "
+                    f"(holder rank {root})", rank=self._rank)
 
     def checkpoint(self, global_bytes: bytes,
                    local_bytes: Optional[bytes] = None) -> None:

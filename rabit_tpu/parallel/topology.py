@@ -205,6 +205,8 @@ def fetch_topo(host: str, port: int, task_id: str = "0",
             _send_str(conn, task_id)
             _send_u32(conn, 0)  # num_attempt (informational)
             doc = json.loads(_recv_str(conn))
+        from ..telemetry import clock
+        clock.merge_from_doc(doc)   # HLC piggyback (ISSUE 20)
         groups = doc.get("groups")
         if not groups:
             return None

@@ -30,6 +30,7 @@ from .aggregate import (merge_summaries,  # noqa: F401  (re-export)
                         format_fleet_table, FLEET_KIND)
 from .schema import (schema_id, make_header,  # noqa: F401  (re-export)
                      matches, timestamp_utc)
+from . import clock
 from ..utils.config import parse_size
 
 _EXPORT_ENV = "RABIT_TELEMETRY_EXPORT"
@@ -50,15 +51,28 @@ def reset(capacity: Optional[int] = None,
     _REC.reset(capacity=capacity, enabled=enabled)
 
 
+def _stamp_round(attrs: dict) -> dict:
+    """Central HLC stamping: any round-carrying span gains an ``hlc``
+    attr when the event plane is on (``rabit_events``), so cross-rank
+    stitching can order arrivals causally instead of trusting wall
+    anchors — no per-engine call-site changes, and with the knob unset
+    the attrs dict is returned untouched (byte-identical spans)."""
+    if "round" in attrs and "hlc" not in attrs:
+        stamp = clock.tick()
+        if stamp is not None:
+            attrs["hlc"] = stamp
+    return attrs
+
+
 def span(name: str, nbytes: int = 0, op=None, method=None, wire=None,
          **attrs):
     """Timed context for one operation — the tentpole entry point."""
     return _REC.span(name, nbytes=nbytes, op=op, method=method, wire=wire,
-                     **attrs)
+                     **_stamp_round(attrs))
 
 
 def record_span(name: str, dur_s: float, nbytes: int = 0, **kw) -> None:
-    _REC.record_span(name, dur_s, nbytes=nbytes, **kw)
+    _REC.record_span(name, dur_s, nbytes=nbytes, **_stamp_round(kw))
 
 
 def count(name: str, nbytes: int = 0, op=None, method=None, wire=None,
@@ -113,6 +127,10 @@ def configure(cfg) -> bool:
     cap = cfg.get("rabit_telemetry_buffer")
     if cap:
         _REC.reset(capacity=max(1, parse_size(cap)), enabled=_REC.enabled)
+    # the fleet event bus + HLC share the rabit_events master knob;
+    # events.configure flips the clock alongside the ring
+    from . import events
+    events.configure(cfg)
     return _REC.enabled
 
 

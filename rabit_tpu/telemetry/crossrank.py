@@ -24,6 +24,7 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional
 
+from . import clock
 from .schema import matches
 
 # Span names that represent one cross-rank collective occurrence. The
@@ -62,6 +63,7 @@ def _records_from_spans(spans: Iterable[dict],
         out.append({"round": int(rnd), "name": s["name"],
                     "phase": attrs.get("phase"),
                     "adapted": attrs.get("adapted"),
+                    "hlc": attrs.get("hlc"),
                     "t_wall": t_base_unix + float(s.get("t0", 0.0)),
                     "dur": _exposed_dur(attrs, float(s.get("dur", 0.0)))})
     return out
@@ -80,6 +82,7 @@ def _records_from_trace(doc: dict) -> List[dict]:
         out.append({"round": int(rnd), "name": ev["name"],
                     "phase": args.get("phase"),
                     "adapted": args.get("adapted"),
+                    "hlc": args.get("hlc"),
                     "t_wall": base + float(ev.get("ts", 0.0)) / 1e6,
                     "dur": _exposed_dur(args,
                                         float(ev.get("dur", 0.0)) / 1e6)})
@@ -111,7 +114,13 @@ def stitch_rounds(per_rank: Dict[int, List[dict]]) -> List[dict]:
     """Merge per-rank round records into per-round rows. Only rounds
     observed on at least two ranks are comparable (a round seen on one
     rank alone has no skew); they are kept with ``skew_s=None`` so a
-    report can still show them."""
+    report can still show them.
+
+    Ordering prefers HLC stamps (``telemetry/clock.py``) when every
+    arrival in a round carries one — causal order survives wall-clock
+    skew between hosts — and falls back to the per-rank
+    ``t_base_unix``-anchored wall time otherwise; each comparable row
+    says which basis it used in ``ordered_by`` (``"hlc"``/``"wall"``)."""
     rounds: Dict[tuple, dict] = {}
     for rank, recs in per_rank.items():
         for r in recs:
@@ -120,21 +129,36 @@ def stitch_rounds(per_rank: Dict[int, List[dict]]) -> List[dict]:
                                           "round": r["round"],
                                           "phase": r.get("phase"),
                                           "adapted": r.get("adapted"),
-                                          "arrivals": {}, "durs": {}})
+                                          "arrivals": {}, "durs": {},
+                                          "hlcs": {}})
             if row.get("phase") is None:
                 row["phase"] = r.get("phase")
             if row.get("adapted") is None:
                 row["adapted"] = r.get("adapted")
             row["arrivals"][rank] = r["t_wall"]
             row["durs"][rank] = r["dur"]
+            if clock.is_stamp(r.get("hlc")):
+                row["hlcs"][rank] = r["hlc"]
     out = []
     for key in sorted(rounds, key=lambda k: (k[0], k[1])):
         row = rounds[key]
         arr = row["arrivals"]
+        hlcs = row["hlcs"]
         if len(arr) >= 2:
-            first_rank = min(arr, key=lambda r: arr[r])
-            straggler = max(arr, key=lambda r: arr[r])
-            skew = arr[straggler] - arr[first_rank]
+            if len(hlcs) == len(arr):
+                # causal ordering: first/straggler by HLC key, skew
+                # from the stamps' physical-ms component (monotone
+                # with the causal order, so never negative)
+                first_rank = min(hlcs, key=lambda r: clock.key(hlcs[r]))
+                straggler = max(hlcs, key=lambda r: clock.key(hlcs[r]))
+                skew = (hlcs[straggler]["ms"]
+                        - hlcs[first_rank]["ms"]) / 1e3
+                row["ordered_by"] = "hlc"
+            else:
+                first_rank = min(arr, key=lambda r: arr[r])
+                straggler = max(arr, key=lambda r: arr[r])
+                skew = arr[straggler] - arr[first_rank]
+                row["ordered_by"] = "wall"
             row["first_rank"] = first_rank
             row["straggler_rank"] = straggler
             row["skew_s"] = skew
@@ -142,6 +166,7 @@ def stitch_rounds(per_rank: Dict[int, List[dict]]) -> List[dict]:
         else:
             row["first_rank"] = row["straggler_rank"] = None
             row["skew_s"] = row["critical_path_s"] = None
+            row["ordered_by"] = None
         out.append(row)
     return out
 
@@ -176,6 +201,82 @@ def stitch_documents(docs: Iterable[dict]) -> List[dict]:
         if got is not None:
             per_rank[got[0]] = got[1]
     return stitch_rounds(per_rank)
+
+
+def _anchor_of(doc: dict) -> Optional[tuple]:
+    """``(rank, t_base_unix)`` for any round-carrying artifact shape
+    (mirrors :func:`extract_rounds`'s routing), or None."""
+    if matches(doc, "telemetry_trace"):
+        rank = next((ev.get("pid", 0) for ev in doc.get("traceEvents", [])),
+                    0)
+    elif matches(doc, "flight_record") or "spans" in doc:
+        rank = doc.get("rank", 0)
+    else:
+        return None
+    base = doc.get("t_base_unix")
+    if base is None:
+        return None
+    return (rank, float(base))
+
+
+def round_gap_s(rounds: List[dict]) -> Optional[float]:
+    """Median wall-time gap between consecutive comparable rounds of
+    the same collective — the stitcher's yardstick for how much anchor
+    disagreement actually matters (anchors off by less than one round
+    gap cannot swap arrival order)."""
+    firsts: Dict[str, List[tuple]] = {}
+    for row in rounds:
+        if row.get("skew_s") is None:
+            continue
+        firsts.setdefault(row["name"], []).append(
+            (row["round"], min(row["arrivals"].values())))
+    gaps = []
+    for lst in firsts.values():
+        lst.sort()
+        gaps.extend(t2 - t1 for (_, t1), (_, t2) in zip(lst, lst[1:])
+                    if t2 > t1)
+    if not gaps:
+        return None
+    gaps.sort()
+    return gaps[len(gaps) // 2]
+
+
+def anchor_warning(docs: Iterable[dict],
+                   rounds: List[dict]) -> Optional[dict]:
+    """Detect silently mis-ordered stitches: when two ranks'
+    ``t_base_unix`` anchors disagree by more than the typical round
+    gap, wall-ordered first/straggler verdicts are unreliable —
+    anything beyond the gap can swap arrival order wholesale. Returns
+    a warning doc (spread, gap, how many rounds fell back to wall
+    ordering) for the stitched report, or None when anchors agree
+    within the gap (or fewer than two anchors exist)."""
+    anchors: Dict[int, float] = {}
+    for doc in docs:
+        got = _anchor_of(doc)
+        if got is not None:
+            anchors[got[0]] = got[1]
+    if len(anchors) < 2:
+        return None
+    spread = max(anchors.values()) - min(anchors.values())
+    gap = round_gap_s(rounds)
+    if gap is None or spread <= gap:
+        return None
+    wall_rows = sum(1 for r in rounds if r.get("ordered_by") == "wall")
+    hlc_rows = sum(1 for r in rounds if r.get("ordered_by") == "hlc")
+    msg = (f"wall-clock anchors disagree by {spread:.3f}s across "
+           f"{len(anchors)} rank(s) — more than the {gap:.3f}s round "
+           "gap, so wall-ordered arrival verdicts are unreliable")
+    if wall_rows and not hlc_rows:
+        msg += (f"; all {wall_rows} comparable round(s) fell back to "
+                "wall ordering (no HLC stamps — enable rabit_events)")
+    elif wall_rows:
+        msg += (f"; {hlc_rows} round(s) causally ordered by HLC, "
+                f"{wall_rows} fell back to wall ordering")
+    else:
+        msg += f"; all {hlc_rows} round(s) causally ordered by HLC"
+    return {"anchor_spread_s": spread, "round_gap_s": gap,
+            "ranks": sorted(anchors), "wall_rounds": wall_rows,
+            "hlc_rounds": hlc_rows, "message": msg}
 
 
 # -- live straggler snapshot (counter-only inputs) -------------------------

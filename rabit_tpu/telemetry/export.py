@@ -38,6 +38,19 @@ def build_summary(snapshot: dict, rank: int = -1,
     from . import profile
     if profile.enabled():
         doc["profile"] = profile.snapshot()
+    # the fleet event bus rides the summary the same way: per-rank
+    # /summary scrapes and the metrics wire command both deliver the
+    # bounded ring (with its monotonic seq, so the tracker dedups) to
+    # the per-job fleet event log; the rank's current HLC stamp rides
+    # along so the tracker's clock merges every sender's causal past.
+    # Both sections appear only when rabit_events is on (byte-identical
+    # payloads otherwise).
+    from . import clock, events
+    if events.enabled():
+        doc["events"] = events.snapshot()
+        stamp = clock.tick()
+        if stamp is not None:
+            doc["hlc"] = stamp
     return doc
 
 

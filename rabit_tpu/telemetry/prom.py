@@ -111,6 +111,9 @@ METRIC_FAMILIES = (
     "rabit_tracker_loop_lag_ms",
     "rabit_wal_snapshot_seq",
     "rabit_sched_preemptions_total",
+    # causal incident plane (telemetry/incident.py, ISSUE 20)
+    "rabit_open_incidents",
+    "rabit_events_dropped_total",
 )
 
 

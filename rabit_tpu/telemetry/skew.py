@@ -355,6 +355,8 @@ def _fetch_skew_raw(host: str, port: int, task_id: str = "0",
         doc = json.loads(raw)
     except ValueError:
         return True, None
+    from . import clock
+    clock.merge_from_doc(doc)   # HLC piggyback (ISSUE 20)
     return True, parse_digest(doc)
 
 

@@ -343,7 +343,10 @@ def submit(host: str, port: int, job_id: str, nworkers: int,
         _send_str(conn, str(job_id))
         _send_u32(conn, 0)
         _send_str(conn, payload)
-        return json.loads(_recv_str(conn))
+        verdict = json.loads(_recv_str(conn))
+    from ..telemetry import clock
+    clock.merge_from_doc(verdict)   # HLC piggyback (ISSUE 20)
+    return verdict
 
 
 def submit_blocking(host: str, port: int, job_id: str, nworkers: int,

@@ -404,6 +404,11 @@ def launch(nworkers: int, cmd: List[str], max_attempts: int = 20,
             # metrics command) — how cluster tests assert that recovery
             # spans/counters actually fired on the workers
             stats["fleet_metrics"] = tracker.merged_metrics()
+            # causal incident plane (ISSUE 20): the folded fleet event
+            # log + incident book, when ``rabit_events`` armed them
+            if tracker._events_on:
+                stats["fleet_events"] = tracker._events_doc()
+                stats["incidents"] = tracker._incidents_doc()
             # live observability plane: endpoints announced, poll
             # sweeps completed, and the last straggler snapshot —
             # captured BEFORE tracker.stop() tears the poller down

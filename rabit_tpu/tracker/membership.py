@@ -193,6 +193,8 @@ def fetch_world(host: str, port: int, task_id: str = "0",
             _send_str(conn, task_id)
             _send_u32(conn, 0)  # num_attempt (informational)
             doc = json.loads(_recv_str(conn))
+        from ..telemetry import clock
+        clock.merge_from_doc(doc)   # HLC piggyback (ISSUE 20)
         return doc if isinstance(doc, dict) and doc else None
     except (OSError, ValueError, ConnectionError, retry.RetryError):
         return None

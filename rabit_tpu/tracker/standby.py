@@ -371,6 +371,12 @@ class StandbyTracker:
         flight.note("tracker_failover",
                     f"standby {self.node_id} promoted on "
                     f"{self.host}:{self.port} at seq {acked}")
+        from ..telemetry import events
+        events.emit("tracker.promoted",
+                    f"standby {self.node_id} promoted on "
+                    f"{self.host}:{self.port} at seq {acked}",
+                    failover_ms=round(tr.failover_duration_ms, 3)
+                    if tr is not None else None)
         self._log(f"promoted: serving epoch "
                   f"{tr._epoch} with "
                   f"{len(tr._ranks)} known ranks")

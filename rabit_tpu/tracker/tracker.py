@@ -98,6 +98,11 @@ Wire protocol (binary, little-endian, length-prefixed strings):
     and network namespace, so the UDS fast path needs no same-host
     inference: resolving the name IS the proof, and failure falls back
     to TCP per-pair.
+With the causal incident plane on (``rabit_events``, ISSUE 20) every
+JSON-str tracker reply above (topo/skew/world/submit) piggybacks one
+extra ``"hlc"`` field — the tracker's hybrid logical clock stamp, which
+workers merge so fleet events order causally across hosts. u32 replies
+never change, and with the knob unset no reply grows a byte.
 Workers connect to lower-ranked neighbors and accept from higher ranks.
 The epoch counts completed registration batches: every live worker
 re-registers in the same batch during recovery, so all members of a
@@ -119,6 +124,9 @@ import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
+from ..telemetry import clock as _clock
+from ..telemetry import events as _events
+from ..telemetry import incident as _incident
 from ..telemetry.aggregate import format_fleet_table, merge_summaries
 from . import evloop as _evloop
 from . import jobs as _jobs_mod
@@ -664,6 +672,31 @@ class Tracker:
         self._poll_thread: Optional[threading.Thread] = None  # fleet-global
         self._poll_stop = threading.Event()  # fleet-global
         self._poll_count = 0                # fleet-global: sweep counter
+        # causal incident plane (ISSUE 20): HLC-stamped fleet events +
+        # automated root-cause attribution, all off unless the
+        # ``rabit_events``/RABIT_EVENTS master knob is set. With the
+        # knob unset none of this grows a wire byte, a route, or a
+        # gauge — the control plane is byte-identical to before. With
+        # it set: worker summaries carry their event rings, the tracker
+        # folds them (dedup by per-task seq) into one fleet event log
+        # served at /events, JSON-str wire replies piggyback the
+        # tracker's HLC so worker clocks causally follow the control
+        # plane, and the poll loop runs an incident sweep correlating
+        # SLO burns and watchdog aborts against the event log
+        # (served at /incidents, dumped alongside flight records).
+        self._events_on = _events.enabled()  # fleet-global: plane flag
+        self._fleet_events: Deque[dict] = deque(  # fleet-global: event log
+            maxlen=4 * _events.stats()["capacity"])
+        self._event_seen: Dict[str, int] = {}   # fleet-global: dedup seqs
+        self._event_drops: Dict[str, int] = {}  # fleet-global: per-task
+        # leaf lock serializing fold cursors only — never held while
+        # acquiring any other lock (C002)
+        self._events_fold_lock = threading.Lock()  # fleet-global
+        self._incidents = _incident.IncidentBook()  # fleet-global
+        self._incident_log: Deque[dict] = deque(maxlen=64)  # fleet-global
+        self._slo_prev: Dict[str, str] = {}  # fleet-global: slo edges
+        if self._events_on:
+            _clock.set_node(f"tracker:{node_id}")
         # crash-recoverable control plane (ISSUE 10): when a WAL dir is
         # configured (``rabit_tracker_wal_dir``), every control-plane
         # transition below is journaled through tracker/wal.py BEFORE
@@ -964,6 +997,9 @@ class Tracker:
         flight.note("tracker_resume",
                     f"replayed {nrecords} WAL records, restart "
                     f"#{self.restarts}, epoch {self._epoch}{jobs_note}")
+        self._fleet_emit("tracker.resume",
+                         f"replayed {nrecords} WAL records, restart "
+                         f"#{self.restarts}, epoch {self._epoch}")
         print(f"[tracker] resumed from WAL ({nrecords} records, "
               f"restart #{self.restarts}, epoch {self._epoch}, "
               f"{len(self._ranks)} known ranks{jobs_note})",
@@ -1289,9 +1325,7 @@ class Tracker:
                 summary_fn=lambda: self.merged_metrics() or {},
                 gauges_fn=self._live_gauges,
                 identity=identity,
-                routes={"/straggler": self._straggler_doc,
-                        "/jobs": self._jobs_doc,
-                        "/slo": self._slo_doc},
+                routes=self._live_routes(),
             ).start()
         except OSError as e:
             print(f"[tracker] metrics server failed to bind port "
@@ -1306,6 +1340,18 @@ class Tracker:
         self._poll_thread = threading.Thread(
             target=self._poll_loop, name="rabit-tracker-poll", daemon=True)
         self._poll_thread.start()
+
+    def _live_routes(self) -> dict:
+        """Extra JSON routes on the live endpoint. /events and
+        /incidents exist only while the incident plane is on — an
+        unconfigured tracker's route table is unchanged."""
+        routes = {"/straggler": self._straggler_doc,
+                  "/jobs": self._jobs_doc,
+                  "/slo": self._slo_doc}
+        if self._events_on:
+            routes["/events"] = self._events_doc
+            routes["/incidents"] = self._incidents_doc
+        return routes
 
     def _jl(self, jid: str, **labels) -> Dict[str, str]:
         """Gauge labels for one job's row: a ``job`` label only when
@@ -1531,6 +1577,12 @@ class Tracker:
             # single-job tracker's exposition stays byte-identical
             from ..telemetry import slo as _slo
             gauges.extend(_slo.gauges(self._slo_verdicts()))
+        if self._events_on:
+            # incident plane gauges (ISSUE 20): only with the plane on
+            # — an unconfigured exposition stays byte-identical
+            gauges.extend(_incident.gauges(
+                self._incidents.open_docs(),
+                self._events_dropped_total()))
         return gauges
 
     def _slo_verdicts(self) -> list:
@@ -1560,6 +1612,146 @@ class Tracker:
         line)."""
         from ..telemetry import slo as _slo
         return _slo.burn_doc(self._slo_verdicts())
+
+    # -- causal incident plane (ISSUE 20) ---------------------------------
+    def _fleet_emit(self, kind: str, detail: str = "", job: str = "",
+                    rank: int = -1, **attrs) -> None:
+        """Tracker-side fleet event: recorded in the process ring,
+        then the ring is folded into the fleet log — the tracker is
+        its own consumer, control-plane events never need a scrape
+        hop. Never takes self._lock (callers hold it in several paths
+        and it is not reentrant); the fold's own leaf lock is safe
+        under it."""
+        if not self._events_on:
+            return
+        _events.emit(kind, detail=detail, job=job, rank=rank, **attrs)
+        self._fold_local_ring()
+
+    def _fold_local_ring(self) -> None:
+        """Fold this process's OWN event ring into the fleet log.
+
+        The ring is process-global, so in-process co-tenants — the
+        launcher's chaos proxies, a hot standby stamping its
+        promotion — share it with the tracker's `_fleet_emit`; folding
+        the ring (dedup'd by seq like any worker fold) is what gets
+        their events into `/events` and the incident sweep's causal
+        window."""
+        if not self._events_on:
+            return
+        with self._events_fold_lock:
+            seen = self._event_seen.get("__local__", 0)
+            newest = seen
+            for rec in _events.snapshot()["records"]:
+                seq = rec.get("seq", 0)
+                if not isinstance(seq, int) or seq <= seen:
+                    continue
+                newest = max(newest, seq)
+                rec = dict(rec)
+                rec["source"] = "tracker"
+                self._fleet_events.append(rec)
+            self._event_seen["__local__"] = newest
+
+    def _fold_events(self, task_id: str, doc: dict, job) -> None:
+        """Fold one worker summary's event ring into the fleet log.
+
+        Records arrive repeatedly (every scrape re-ships the ring);
+        the per-task ``seq`` is the dedup cursor. The worker's HLC
+        merges into the tracker's clock so every tracker-side stamp
+        causally follows everything it has observed, and the worker's
+        cumulative ring-drop count feeds the fleet-wide gauge."""
+        if not self._events_on or not isinstance(doc, dict):
+            return
+        _clock.merge_from_doc(doc)
+        ev = doc.get("events")
+        if not isinstance(ev, dict):
+            return
+        with self._events_fold_lock:
+            seen = self._event_seen.get(task_id, 0)
+            newest = seen
+            for rec in ev.get("records", ()):
+                if not isinstance(rec, dict):
+                    continue
+                seq = rec.get("seq", 0)
+                if not isinstance(seq, int) or seq <= seen:
+                    continue
+                newest = max(newest, seq)
+                rec = dict(rec)
+                rec["source"] = task_id
+                if job is not None and not rec.get("job"):
+                    rec["job"] = job.job_id
+                self._fleet_events.append(rec)
+            self._event_seen[task_id] = newest
+            dropped = ev.get("dropped")
+            if isinstance(dropped, int) and dropped >= 0:
+                self._event_drops[task_id] = dropped
+
+    def _events_dropped_total(self) -> int:
+        """Fleet-wide dropped events: every task's cumulative ring
+        drops plus the tracker's own ring."""
+        return sum(self._event_drops.values()) \
+            + _events.stats()["dropped"]
+
+    def _events_doc(self) -> dict:
+        """The ``/events`` route: the folded fleet event log in causal
+        order (HLC when stamped, wall time otherwise)."""
+        from ..telemetry.schema import make_header
+        self._fold_local_ring()
+        evs = sorted(self._fleet_events, key=_incident._event_key)
+        doc = make_header(_events.EVENT_KIND)
+        doc["events"] = evs
+        doc["count"] = len(evs)
+        doc["dropped"] = self._events_dropped_total()
+        return doc
+
+    def _incidents_doc(self) -> dict:
+        """The ``/incidents`` route: open incidents plus the recent
+        history (capture_status.py --live folds open count, worst
+        severity, and the newest attribution line)."""
+        open_docs = self._incidents.open_docs()
+        return {"open": open_docs,
+                "open_count": len(open_docs),
+                "worst": self._incidents.worst(),
+                "closed_total": self._incidents.closed_total,
+                "recent": list(self._incident_log)}
+
+    def _incident_sweep(self) -> None:
+        """One poll-loop pass of the incident engine: emit slo.* state
+        -change events on verdict edges, correlate each warn/violating
+        verdict and each unseen watchdog abort against the fleet event
+        log, dump newly opened incidents alongside the flight
+        records."""
+        from ..telemetry import flight
+        self._fold_local_ring()
+        verdicts = self._slo_verdicts()
+        events_now = list(self._fleet_events)
+        opened = []
+        for v in verdicts:
+            name = str(v.get("slo", "?"))
+            state = str(v.get("state", ""))
+            if self._slo_prev.get(name) != state:
+                self._slo_prev[name] = state
+                kind = f"slo.{state}"
+                if kind in _events.EVENT_KINDS:
+                    self._fleet_emit(
+                        kind, f"{name} = {v.get('value')} "
+                              f"{v.get('unit', '')} (burn "
+                              f"{v.get('burn')})")
+            inc = self._incidents.observe_slo(v, events_now)
+            if inc is not None:
+                opened.append(inc)
+        opened.extend(self._incidents.observe_events(events_now))
+        if not opened:
+            return
+        fr = flight.installed()
+        out_dir = fr.out_dir if fr is not None \
+            else os.environ.get("RABIT_FLIGHT_DIR")
+        for inc in opened:
+            self._incident_log.append(inc)
+            if out_dir:
+                _incident.dump(inc, out_dir)
+            print(f"[tracker] incident {inc.get('id')} "
+                  f"[{inc.get('severity')}]: {inc.get('summary')}",
+                  file=sys.stderr, flush=True)
 
     def _straggler_doc(self) -> dict:
         """The ``/straggler`` route: the default job's snapshot (shape
@@ -1611,6 +1803,7 @@ class Tracker:
                         with self._lock:
                             job._metrics[tid] = doc
                             job._endpoint_misses[tid] = 0
+                        self._fold_events(tid, doc, job)
                         continue
                     # post-resume grace (ISSUE 10): right after a
                     # tracker resume every poller in the fleet is still
@@ -1688,6 +1881,11 @@ class Tracker:
             if polled:
                 with self._lock:
                     self._poll_count += 1
+            if self._events_on:
+                # incident sweep rides the poll cadence even when no
+                # endpoint answered: tracker-side events (membership,
+                # admission, SLO edges) still need correlating
+                self._incident_sweep()
 
     def live_addr(self) -> Optional[Tuple[str, int]]:
         """The live /healthz endpoint's ``(host, port)``, or None when
@@ -1782,6 +1980,18 @@ class Tracker:
         self._loop.send(conn, struct.pack("<I", len(b)) + b,
                         close_after=close)
 
+    def _reply_json(self, conn, doc: dict) -> None:
+        """JSON-str reply with the tracker's HLC piggybacked when the
+        incident plane is on (ISSUE 20) — workers fold the stamp so
+        their clocks causally follow the control plane. Never added to
+        u32 replies, and with ``rabit_events`` unset the wire bytes
+        are identical to a plain ``_reply_str``."""
+        if self._events_on:
+            stamp = _clock.tick()
+            if stamp is not None:
+                doc["hlc"] = stamp
+        self._reply_str(conn, json.dumps(doc))
+
     def _handle(self, conn, cmd: str, job_id: str, task_id: str,
                 args: tuple) -> None:
         """Job-scoped command execution on a service-pool thread. Any
@@ -1817,6 +2027,7 @@ class Tracker:
             if ok:
                 with self._lock:
                     job._metrics[task_id] = doc
+                self._fold_events(task_id, doc, job)
             self._reply_u32(conn, 1 if ok else 0)
         elif cmd == "endpoint":
             try:
@@ -1846,15 +2057,15 @@ class Tracker:
             job = self._job_for(job_id)
             with self._lock:
                 doc = {} if job is None else dict(job._topo)
-            self._reply_str(conn, json.dumps(doc))
+            self._reply_json(conn, doc)
         elif cmd == "skew":
             job = self._job_for(job_id)
             with self._lock:
                 doc = {} if job is None else dict(job._skew)
-            self._reply_str(conn, json.dumps(doc))
+            self._reply_json(conn, doc)
         elif cmd == "world":
-            self._reply_str(conn, json.dumps(
-                self.membership_doc(self._job_for(job_id))))
+            self._reply_json(conn,
+                             self.membership_doc(self._job_for(job_id)))
         elif cmd == "resume":
             # post-restart handshake (ISSUE 10): a live worker
             # re-presents its (task_id, stable_rank, epoch) so the
@@ -1898,7 +2109,7 @@ class Tracker:
             # admission control: answer IMMEDIATELY with a verdict
             # (admitted / queued+retry_after / shed+retry_after) —
             # overload sheds, it never stalls a submitter's socket
-            self._reply_str(conn, json.dumps(self._submit(args[0])))
+            self._reply_json(conn, self._submit(args[0]))
         elif cmd == "join":
             host, port, flags, token = args
             job = self._job_for_register(job_id)
@@ -1967,6 +2178,9 @@ class Tracker:
         flight.note("job_quarantine",
                     f"job {job_id}: {cmd} raised "
                     f"{type(exc).__name__}: {exc}")
+        self._fleet_emit("tracker.quarantine",
+                         f"{cmd} raised {type(exc).__name__}: {exc}",
+                         job=job_id)
         print(f"[tracker] quarantined {cmd} for job {job_id}: "
               f"{type(exc).__name__}: {exc}", file=sys.stderr, flush=True)
 
@@ -2041,6 +2255,8 @@ class Tracker:
             job = self._jobs.get(job_id)
             if job is not None and job.open:
                 self.submit_admitted_total += 1
+                self._fleet_emit("admission.admitted",
+                                 f"{job_id} already open", job=job_id)
                 return {"ok": 1, "job": job_id, "already": 1}
             if self._max_fleet_ranks and n > self._max_fleet_ranks:
                 return {"ok": 0,
@@ -2050,6 +2266,9 @@ class Tracker:
             if self._fits_locked(n):
                 self._open_job_locked(job_id, n, elastic, cls, weight)
                 self.submit_admitted_total += 1
+                self._fleet_emit("admission.admitted",
+                                 f"{job_id} opened at {n} ranks",
+                                 job=job_id)
                 return {"ok": 1, "job": job_id}
             plan = self._plan_preemption_locked(n, cls) if cls else None
         if plan:
@@ -2062,14 +2281,23 @@ class Tracker:
             if self._fits_locked(n):   # capacity freed while unlocked
                 self._open_job_locked(job_id, n, elastic, cls, weight)
                 self.submit_admitted_total += 1
+                self._fleet_emit("admission.admitted",
+                                 f"{job_id} opened at {n} ranks",
+                                 job=job_id)
                 return {"ok": 1, "job": job_id}
             pos = self._admission.offer(
                 {"job": job_id, "nworkers": n, "elastic": elastic,
                  "sched_class": cls, "weight": weight})
             if pos < 0:
                 depth = len(self._admission)
+                self._fleet_emit("admission.shed",
+                                 f"{job_id} shed past queue depth "
+                                 f"{depth}", job=job_id)
                 return {"ok": 0, "shed": 1,
                         "retry_after_ms": retry * (depth + 1)}
+            self._fleet_emit("admission.queued",
+                             f"{job_id} parked at position {pos}",
+                             job=job_id)
             return {"ok": 0, "queued": 1, "position": pos,
                     "retry_after_ms": retry * (pos + 1)}
 
@@ -2441,6 +2669,9 @@ class Tracker:
                               op=kind, provenance="membership",
                               rank=rank, detail=detail)
         flight.note(f"member_{kind}", f"rank {rank}:{jtag} {detail}")
+        self._fleet_emit(f"membership.{kind}", detail,
+                         job="" if job is None else job.job_id,
+                         rank=rank)
         print(f"[tracker] membership:{jtag} {kind} rank {rank} "
               f"({detail})", file=sys.stderr, flush=True)
 

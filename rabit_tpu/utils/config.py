@@ -59,6 +59,9 @@ ENV_VARS = [
     "RABIT_METRICS_POLL_MS",
     "RABIT_FLIGHT_DIR",
     "RABIT_FLIGHT_KEEP",
+    "RABIT_EVENTS",
+    "RABIT_EVENTS_BUFFER",
+    "RABIT_INCIDENT_WINDOW_MS",
     "RABIT_WORLD_SIZE",
     "RABIT_RANK",
     "rabit_world_size",

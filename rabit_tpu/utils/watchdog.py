@@ -254,8 +254,11 @@ class Watchdog:
                         provenance="recovery")
         telemetry.record_span("watchdog.stall", stalled, nbytes=g.nbytes,
                               op=g.name, provenance="recovery")
-        from ..telemetry import flight
+        from ..telemetry import events, flight
         flight.note("watchdog_expired",
+                    f"{g.name} stalled {stalled:.1f}s "
+                    f"(deadline {g.deadline_s:.1f}s)")
+        events.emit("watchdog.retry",
                     f"{g.name} stalled {stalled:.1f}s "
                     f"(deadline {g.deadline_s:.1f}s)")
         log.log_warn("watchdog: %s stalled %.1fs past its %.1fs deadline; "
@@ -272,8 +275,11 @@ class Watchdog:
     def _reform(self, g: _Guard) -> None:
         stalled = time.monotonic() - g.t0
         from .. import telemetry
+        from ..telemetry import events
         telemetry.count("watchdog.reform", nbytes=g.nbytes, op=g.name,
                         provenance="recovery")
+        events.emit("watchdog.reform",
+                    f"{g.name} stalled {stalled:.1f}s past retry rung")
         log.log_warn("watchdog: %s still stalled %.1fs after retry rung; "
                      "escalating to world re-formation%s", g.name, stalled,
                      " (abort on further stall)" if self.abort else "")
@@ -305,7 +311,9 @@ class Watchdog:
         # os._exit: ring buffer, recent events, and every thread's stack
         # — including the one stalled inside the C++ recv we are about
         # to kill the process over
-        from ..telemetry import flight
+        from ..telemetry import events, flight
+        events.emit("watchdog.abort",
+                    f"{g.name} ({g.nbytes} bytes) stalled past grace")
         flight.trigger("watchdog_abort",
                        f"{g.name} ({g.nbytes} bytes) stalled past grace")
         self._abort_fn(WATCHDOG_EXIT_CODE)

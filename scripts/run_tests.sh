@@ -113,6 +113,13 @@ python -m rabit_tpu.tracker.evloop --smoke
 python -m rabit_tpu.tracker.autoscaler --smoke
 python tools/tracker_bench.py --smoke --quiet
 
+echo "== tier 0p: incident-plane smoke (HLC -> event bus -> attribution) =="
+# hybrid logical clocks merge monotonically across skewed nodes, the
+# fleet event ring keeps exact drop counts, and the incident engine
+# attributes a violating SLO verdict to the seeded chaos cause (and
+# marks an empty-window trigger explicitly unattributed)
+python -m rabit_tpu.telemetry.incident --smoke
+
 echo "== build native =="
 cmake -S native -B native/build -G Ninja >/dev/null
 cmake --build native/build --parallel

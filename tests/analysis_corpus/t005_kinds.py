@@ -1,0 +1,25 @@
+"""T005 corpus: every literal fleet-event kind must be registered in
+rabit_tpu/telemetry/events.py EVENT_KINDS. Registered kinds and
+dynamic (non-literal) kinds must stay silent; unregistered literals —
+through emit(), a tracker-style _fleet_emit() wrapper, or the
+emit_chaos() chaos.<kind> mapping — must each fire once."""
+
+from rabit_tpu.telemetry import events
+
+
+class Escalator:
+    def _fleet_emit(self, kind, detail=""):
+        events.emit(kind, detail)
+
+    def rungs(self, name):
+        events.emit("watchdog.retry", f"{name} stalled")
+        events.emit("watchdog.meltdown", "no such rung")  # expect: T005
+        self._fleet_emit("tracker.promoted", "standby took over")
+        self._fleet_emit("tracker.demoted", "bad")  # expect: T005
+
+
+def inject(conn_index):
+    events.emit_chaos("reset", f"conn#{conn_index}")
+    events.emit_chaos("gamma_ray", "cosmic")  # expect: T005
+    kind = "recovery." + "retry"
+    events.emit(kind, "dynamic kinds are emit()'s runtime check")

@@ -98,6 +98,22 @@ def test_c003_corpus_warns_and_noqa():
     assert a.RULES["C003"].tier == "warn"
 
 
+def test_t005_corpus_exact_lines():
+    findings = _run_fixture("t005_kinds.py", codes={"T005"})
+    got = {(line, code) for _rel, line, code, _msg in findings}
+    assert got == _expected_markers("t005_kinds.py")
+    for _rel, _line, _code, msg in findings:
+        assert "EVENT_KINDS" in msg
+
+
+def test_t005_clean_on_real_repo(repo_findings):
+    """Every fleet-event kind the repo actually emits is registered —
+    the committed-registry half of the T005 contract."""
+    _a, findings = repo_findings
+    t005 = [f for f in findings if f[2] == "T005"]
+    assert t005 == [], t005
+
+
 def test_r007_corpus_exact_lines():
     """R007 is path-gated to tracker/tracker.py, so the fixture is
     parsed here and driven through _r007_issues with the real rel."""
@@ -237,7 +253,7 @@ def test_registry_metadata_complete():
     a = _analysis()
     assert set(a.RULES) == {
         "E999", "W291", "W191", "F401",
-        "T001", "T002", "T003",
+        "T001", "T002", "T003", "T004", "T005",
         "R001", "R002", "R003", "R004", "R005", "R006", "R007",
         "C001", "C002", "C003",
     }

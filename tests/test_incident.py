@@ -1,0 +1,470 @@
+"""Causal incident plane (ISSUE 20) unit battery: hybrid logical
+clocks under skewed/stalled wall clocks, the bounded fleet-event ring,
+attribution window edges and the unattributed fallback, incident
+bookkeeping, HLC-preferring cross-rank stitching, and the
+byte-identical-when-disabled contract (wire replies, span attrs, and
+summary docs must not grow a field with the knob unset)."""
+
+import json
+import os
+import socket
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from rabit_tpu.telemetry import clock, crossrank, events, incident, slo  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _fresh_plane():
+    """Every test starts (and leaves) with the plane in its env-default
+    state — RABIT_EVENTS is unset in CI, so that means disabled."""
+    events.reset()
+    clock.reset()
+    yield
+    events.reset()
+    clock.reset()
+
+
+# ---------------------------------------------------------------- HLC
+
+def test_hlc_monotonic_under_stalled_wall():
+    wall = [1000]
+    c = clock.HLC("a", wall_ms=lambda: wall[0])
+    stamps = [c.tick() for _ in range(5)]
+    keys = [clock.key(s) for s in stamps]
+    assert keys == sorted(set(keys)), "ticks must be strictly monotonic"
+    # wall stepping BACKWARD must not reorder anything
+    wall[0] = 500
+    back = c.tick()
+    assert clock.key(back) > keys[-1]
+    # wall catching up resets the logical counter
+    wall[0] = 2000
+    fwd = c.tick()
+    assert fwd["ms"] == 2000 and fwd["lc"] == 0
+    assert clock.key(fwd) > clock.key(back)
+
+
+def test_hlc_merge_orders_after_both_despite_skew():
+    """Receiver's wall clock is an hour behind the sender's: the merged
+    stamp still orders after everything the sender had seen."""
+    ahead = clock.HLC("fast", wall_ms=lambda: 7_200_000)
+    behind = clock.HLC("slow", wall_ms=lambda: 3_600_000)
+    local_before = behind.tick()
+    remote = ahead.tick()
+    merged = behind.merge(remote)
+    assert clock.key(merged) > clock.key(remote)
+    assert clock.key(merged) > clock.key(local_before)
+    # a later local tick on the receiver keeps ordering after the merge
+    # even though its wall never reaches the sender's
+    assert clock.key(behind.tick()) > clock.key(merged)
+    # equal-ms branch: both at the merged ms -> lc = max + 1
+    twin_a = clock.HLC("a", wall_ms=lambda: 1000)
+    twin_b = clock.HLC("b", wall_ms=lambda: 1000)
+    sa = twin_a.tick()
+    sa2 = twin_a.tick()
+    m = twin_b.merge(sa2)
+    assert m["ms"] == 1000 and m["lc"] == sa2["lc"] + 1
+    assert sa["lc"] < sa2["lc"]
+
+
+def test_hlc_malformed_and_disabled_paths():
+    assert clock.key(None) == (-1, -1, "")
+    assert clock.key({"ms": "x"}) == (-1, -1, "")
+    assert not clock.is_stamp({"ms": 1})
+    assert clock.is_stamp({"ms": 1, "lc": 0})
+    c = clock.HLC("n", wall_ms=lambda: 10)
+    t0 = c.tick()
+    assert clock.key(c.merge("garbage")) > clock.key(t0)  # degrades to tick
+    # module-level hooks are None/no-op while disabled
+    clock.reset("n", enabled=False)
+    assert clock.tick() is None
+    assert clock.merge({"ms": 1, "lc": 0, "node": "x"}) is None
+    clock.merge_from_doc({"no_hlc": True})  # must not raise
+
+
+# ----------------------------------------------------------- event ring
+
+def test_ring_overflow_counts_drops_exactly():
+    events.reset(capacity=4, enabled=True)
+    for i in range(10):
+        events.emit("recovery.retry", f"try {i}")
+    snap = events.snapshot()
+    assert snap["seq"] == 10
+    assert snap["dropped"] == 6
+    assert len(snap["records"]) == 4
+    # overwrite-oldest: the survivors are the newest, in emission order
+    assert [r["seq"] for r in snap["records"]] == [7, 8, 9, 10]
+    assert all(clock.is_stamp(r["hlc"]) for r in snap["records"])
+
+
+def test_emit_enforces_registry_and_gating():
+    events.reset(enabled=True)
+    with pytest.raises(ValueError, match="T005"):
+        events.emit("watchdog.meltdown")  # noqa: T005 - negative test
+    # unregistered chaos rule kinds are dropped, never a crash in the
+    # injection path
+    assert events.emit_chaos("gamma_ray") is None  # noqa: T005 - negative test
+    assert events.emit_chaos("reset", "conn#0")["kind"] == "chaos.reset"
+    events.reset(enabled=False)
+    assert events.emit("watchdog.retry") is None
+    assert events.snapshot()["seq"] == 0
+
+
+# ----------------------------------------------------- attribution math
+
+def _ev(kind, t, **kw):
+    rec = {"kind": kind, "t_unix": t, "seq": kw.pop("seq", 1)}
+    rec.update(kw)
+    return rec
+
+
+def test_attribution_window_edges():
+    t = 1_000_000.0
+    trig = incident.slo_trigger(
+        {"slo": "p99_ms", "state": slo.VIOLATING, "value": 9000.0,
+         "burn": 4.5}, t_unix=t)
+    evs = [
+        _ev("recovery.retry", t - 5.0, seq=1),      # exactly on the edge
+        _ev("recovery.retry", t - 5.001, seq=2),    # just outside
+        _ev("recovery.retry", t + 0.1, seq=3),      # after the trigger
+        _ev("slo.violating", t - 1.0, seq=4),       # symptom, never cause
+    ]
+    inc = incident.correlate(trig, evs, window=5000.0, incident_id="w")
+    chain_seqs = [e["seq"] for e in inc["attribution"]]
+    assert chain_seqs == [1]
+    assert not inc["unattributed"]
+    assert inc["severity"] == incident.SEV_CRITICAL
+    assert inc["window_ms"] == 5000.0
+
+
+def test_unattributed_fallback():
+    trig = incident.slo_trigger(
+        {"slo": "availability", "state": slo.WARN, "value": 0.93,
+         "burn": 0.8}, t_unix=500.0)
+    inc = incident.correlate(trig, [], incident_id="empty")
+    assert inc["unattributed"] is True
+    assert "root_cause" not in inc
+    assert inc["attribution"] == []
+    assert inc["summary"].startswith("unattributed:")
+    assert inc["severity"] == incident.SEV_WARN
+
+
+def test_root_cause_prefers_chaos_over_downstream_recovery():
+    """A chaos injection arriving AFTER the first recovery rung still
+    wins the root slot — priority beats causal position — while the
+    chain keeps causal order."""
+    t = 2_000.0
+    evs = [
+        _ev("recovery.retry", t - 3.0, seq=1, rank=2),
+        _ev("chaos.reset", t - 2.0, seq=2),
+        _ev("watchdog.retry", t - 1.0, seq=3, rank=2, job="a"),
+    ]
+    trig = incident.slo_trigger(
+        {"slo": "p99_ms", "state": slo.VIOLATING, "value": 1e4,
+         "burn": 5.0}, t_unix=t, job="a")
+    inc = incident.correlate(trig, evs, window=10_000.0, incident_id="rc")
+    assert inc["root_cause"]["kind"] == "chaos.reset"
+    assert [e["kind"] for e in inc["attribution"]] == [
+        "recovery.retry", "chaos.reset", "watchdog.retry"]
+    assert inc["ranks"] == [2]
+    assert inc["jobs"] == ["a"]
+    assert "chaos.reset" in inc["summary"]
+    assert "p99_ms violating" in inc["summary"]
+
+
+def test_incident_book_open_escalate_close_and_abort_dedup():
+    book = incident.IncidentBook(window=60_000.0)
+    t = 100.0
+    evs = [_ev("chaos.partition", t - 1.0, seq=1)]
+    warn_v = {"slo": "p99_ms", "state": slo.WARN, "value": 1800.0,
+              "burn": 0.9}
+    opened = book.observe_slo(warn_v, evs, t_unix=t)
+    assert opened is not None and opened["severity"] == incident.SEV_WARN
+    # repeated warn: same incident stays open, nothing new is dumped
+    assert book.observe_slo(warn_v, evs, t_unix=t + 1) is None
+    assert len(book.open_docs()) == 1
+    # escalation re-correlates to critical
+    viol_v = dict(warn_v, state=slo.VIOLATING, burn=1.5)
+    assert book.observe_slo(viol_v, evs, t_unix=t + 2) is None
+    assert book.worst() == incident.SEV_CRITICAL
+    # recovery closes it
+    ok_v = dict(warn_v, state=slo.OK, burn=0.1)
+    book.observe_slo(ok_v, evs, t_unix=t + 3)
+    assert book.open_docs() == [] and book.closed_total == 1
+    # watchdog aborts are terminal and dedup'd by (source, seq)
+    abort = _ev("watchdog.abort", t, seq=9, source="w1", rank=1)
+    assert len(book.observe_events([abort])) == 1
+    assert book.observe_events([abort]) == []
+    assert book.worst() == incident.SEV_CRITICAL
+
+
+def test_gauges_shape():
+    open_incs = [{"severity": incident.SEV_WARN},
+                 {"severity": incident.SEV_CRITICAL},
+                 {"severity": incident.SEV_CRITICAL}]
+    rows = incident.gauges(open_incs, events_dropped=7)
+    by_name = {r[0]: r for r in rows}
+    assert set(by_name) == {"rabit_open_incidents",
+                            "rabit_events_dropped_total"}
+    sev_counts = dict((lbl["severity"], v)
+                      for lbl, v in by_name["rabit_open_incidents"][3])
+    assert sev_counts == {"warn": 1, "critical": 2}
+    assert by_name["rabit_events_dropped_total"][3] == [({}, 7)]
+
+
+def test_dump_writes_artifact(tmp_path):
+    inc = incident.correlate(
+        incident.slo_trigger({"slo": "p99_ms", "state": slo.VIOLATING,
+                              "value": 1.0, "burn": 2.0}, t_unix=1.0),
+        [], incident_id="d1")
+    path = incident.dump(inc, str(tmp_path))
+    assert path and os.path.isfile(path)
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["id"] == "d1" and doc["schema"].endswith("incident/v1")
+
+
+# ------------------------------------------- cross-rank HLC stitching
+
+def _rank_doc(rank, base, rounds):
+    """Raw recorder-snapshot shape: [(round, t0_rel, hlc)]."""
+    return {"rank": rank, "t_base_unix": base,
+            "spans": [{"name": "allreduce", "t0": t0, "dur": 0.01,
+                       "attrs": {"round": rnd, "hlc": hlc}}
+                      for rnd, t0, hlc in rounds]}
+
+
+def test_stitch_prefers_hlc_over_skewed_wall_anchors():
+    """Rank 1's anchor is 30 s ahead, so wall time says rank 0 arrived
+    first everywhere; the HLC stamps say otherwise and must win."""
+    h = lambda ms, node: {"ms": ms, "lc": 0, "node": node}  # noqa: E731
+    docs = [
+        _rank_doc(0, 1000.0, [(1, 0.10, h(2000, "r0")),
+                              (2, 1.10, h(3000, "r0"))]),
+        _rank_doc(1, 1030.0, [(1, 0.20, h(1000, "r1")),
+                              (2, 1.20, h(2500, "r1"))]),
+    ]
+    rows = crossrank.stitch_documents(docs)
+    assert [r["ordered_by"] for r in rows] == ["hlc", "hlc"]
+    assert rows[0]["first_rank"] == 1 and rows[0]["straggler_rank"] == 0
+    assert rows[0]["skew_s"] == pytest.approx(1.0)
+    # wall ordering would have blamed rank 1 (anchor 30 s ahead)
+    assert min(rows[0]["arrivals"], key=rows[0]["arrivals"].get) == 0
+
+
+def test_stitch_falls_back_to_wall_without_full_hlc_coverage():
+    docs = [
+        _rank_doc(0, 1000.0, [(1, 0.10, {"ms": 5, "lc": 0, "node": "a"})]),
+        _rank_doc(1, 1000.0, [(1, 0.20, None)]),
+    ]
+    rows = crossrank.stitch_documents(docs)
+    assert rows[0]["ordered_by"] == "wall"
+    assert rows[0]["first_rank"] == 0
+
+
+def test_anchor_warning_fires_only_past_round_gap():
+    def mk(spread):
+        return [
+            _rank_doc(0, 1000.0, [(i, i * 1.0, None) for i in (1, 2, 3)]),
+            _rank_doc(1, 1000.0 + spread,
+                      [(i, i * 1.0, None) for i in (1, 2, 3)]),
+        ]
+    docs = mk(30.0)  # 30 s anchor disagreement vs ~1 s round gap
+    rows = crossrank.stitch_documents(docs)
+    warn = crossrank.anchor_warning(docs, rows)
+    assert warn is not None
+    assert warn["anchor_spread_s"] == pytest.approx(30.0)
+    assert warn["wall_rounds"] == 3 and warn["hlc_rounds"] == 0
+    assert "rabit_events" in warn["message"]  # remedy named
+    # anchors within the gap: silence
+    docs = mk(0.5)
+    assert crossrank.anchor_warning(
+        docs, crossrank.stitch_documents(docs)) is None
+
+
+# ------------------------------- byte-identical-when-disabled contract
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        assert chunk, "peer closed early"
+        buf += chunk
+    return buf
+
+
+def _world_reply_bytes(tr):
+    """Raw payload bytes of a real ``world`` wire round trip."""
+    import struct
+    from rabit_tpu.tracker.tracker import MAGIC
+    with socket.create_connection((tr.host, tr.port), timeout=10) as c:
+        c.sendall(struct.pack("<I", MAGIC))
+        for part in ("world", "0"):
+            b = part.encode()
+            c.sendall(struct.pack("<I", len(b)) + b)
+        c.sendall(struct.pack("<I", 0))  # num_attempt
+        (ln,) = struct.unpack("<I", _recv_exact(c, 4))
+        return _recv_exact(c, ln)
+
+
+def test_wire_replies_byte_identical_with_plane_off():
+    from rabit_tpu.tracker.tracker import Tracker
+    tr = Tracker(2).start()
+    try:
+        assert tr._events_on is False
+        payload = _world_reply_bytes(tr)
+        assert payload == json.dumps(tr.membership_doc()).encode()
+        assert "hlc" not in json.loads(payload)
+        assert set(tr._live_routes()) == {"/straggler", "/jobs", "/slo"}
+        names = {g[0] for g in tr._live_gauges()}
+        assert "rabit_open_incidents" not in names
+        assert "rabit_events_dropped_total" not in names
+    finally:
+        tr.stop()
+
+
+def test_wire_replies_gain_only_hlc_with_plane_on():
+    from rabit_tpu.tracker.tracker import Tracker
+    events.reset(enabled=True)
+    clock.reset("test", enabled=True)
+    tr = Tracker(2).start()
+    try:
+        assert tr._events_on is True
+        doc = json.loads(_world_reply_bytes(tr))
+        base = tr.membership_doc()
+        assert set(doc) == set(base) | {"hlc"}
+        assert clock.is_stamp(doc["hlc"])
+        assert doc["hlc"]["node"].startswith("tracker:")
+        routes = set(tr._live_routes())
+        assert {"/events", "/incidents"} <= routes
+        names = {g[0] for g in tr._live_gauges()}
+        assert {"rabit_open_incidents",
+                "rabit_events_dropped_total"} <= names
+    finally:
+        tr.stop()
+
+
+def test_spans_and_summary_byte_identical_with_plane_off():
+    import rabit_tpu.telemetry as telemetry
+    from rabit_tpu.telemetry.export import build_summary
+    telemetry.reset(capacity=64, enabled=True)
+    try:
+        with telemetry.span("allreduce", round=1):
+            pass
+        snap = telemetry.snapshot()
+        (span_rec,) = snap["spans"]
+        assert "hlc" not in span_rec["attrs"]
+        doc = build_summary(snap, rank=0, world_size=1)
+        assert "events" not in doc and "hlc" not in doc
+    finally:
+        telemetry.reset(enabled=False)
+
+
+def test_spans_and_summary_carry_plane_when_on():
+    import rabit_tpu.telemetry as telemetry
+    from rabit_tpu.telemetry.export import build_summary
+    events.reset(enabled=True)
+    clock.reset("r0", enabled=True)
+    telemetry.reset(capacity=64, enabled=True)
+    try:
+        events.emit("recovery.retry", "attempt 1", rank=0)
+        with telemetry.span("allreduce", round=1):
+            pass
+        snap = telemetry.snapshot()
+        (span_rec,) = snap["spans"]
+        assert clock.is_stamp(span_rec["attrs"]["hlc"])
+        doc = build_summary(snap, rank=0, world_size=1)
+        assert clock.is_stamp(doc["hlc"])
+        kinds = [r["kind"] for r in doc["events"]["records"]]
+        assert kinds == ["recovery.retry"]
+    finally:
+        telemetry.reset(enabled=False)
+
+
+def test_capture_status_live_folds_incidents():
+    """``capture_status --live`` against an events-armed tracker grows
+    an ``incidents`` field: open count, worst severity, and the newest
+    attribution one-liner."""
+    import importlib.util as _ilu
+    from rabit_tpu.tracker.tracker import Tracker
+    events.reset(enabled=True)
+    clock.reset("cap", enabled=True)
+    tr = Tracker(2, metrics_port=0).start()
+    try:
+        evs = [{"kind": "chaos.partition", "detail": "window",
+                "t_unix": 100.0, "seq": 1}]
+        inc = tr._incidents.observe_slo(
+            {"slo": "failover_ms", "state": slo.VIOLATING,
+             "value": 30000.0, "burn": 2.0}, evs, t_unix=101.0)
+        assert inc is not None
+        tr._incident_log.append(inc)
+        host, port = tr.live_stats()["metrics_addr"]
+        spec = _ilu.spec_from_file_location(
+            "capture_status",
+            os.path.join(ROOT, "tools", "capture_status.py"))
+        cap = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(cap)
+        doc, ok = cap.live_status(f"{host}:{port}")
+        assert ok, doc
+        assert doc["incidents"]["open"] == 1
+        assert doc["incidents"]["worst"] == incident.SEV_CRITICAL
+        assert "chaos.partition" in doc["incidents"]["newest"]
+        assert "failover_ms violating" in doc["incidents"]["newest"]
+    finally:
+        tr.stop()
+
+
+def test_capture_status_live_has_no_incidents_field_when_dark():
+    import importlib.util as _ilu
+    from rabit_tpu.tracker.tracker import Tracker
+    tr = Tracker(2, metrics_port=0).start()
+    try:
+        host, port = tr.live_stats()["metrics_addr"]
+        spec = _ilu.spec_from_file_location(
+            "capture_status",
+            os.path.join(ROOT, "tools", "capture_status.py"))
+        cap = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(cap)
+        doc, ok = cap.live_status(f"{host}:{port}")
+        assert ok, doc
+        assert "incidents" not in doc
+    finally:
+        tr.stop()
+
+
+# ----------------------------------------------------- tracker folding
+
+def test_tracker_folds_worker_rings_with_dedup():
+    from rabit_tpu.tracker.tracker import Tracker
+    events.reset(enabled=True)
+    clock.reset("w", enabled=True)
+    tr = Tracker(2)
+    try:
+        ring = {"records": [
+            {"kind": "recovery.link_reset", "detail": "conn RST",
+             "t_unix": 1.0, "seq": 1,
+             "hlc": {"ms": 1000, "lc": 0, "node": "w0"}},
+            {"kind": "watchdog.retry", "detail": "rung 1",
+             "t_unix": 2.0, "seq": 2,
+             "hlc": {"ms": 2000, "lc": 0, "node": "w0"}},
+        ], "seq": 2, "dropped": 3, "capacity": 256}
+        doc = {"events": ring, "hlc": {"ms": 2500, "lc": 0, "node": "w0"}}
+        tr._fold_events("job-a/0", doc, None)
+        tr._fold_events("job-a/0", doc, None)  # re-scrape: no dupes
+        evdoc = tr._events_doc()
+        folded = [e for e in evdoc["events"] if e["source"] == "job-a/0"]
+        assert [e["kind"] for e in folded] == [
+            "recovery.link_reset", "watchdog.retry"]
+        assert evdoc["dropped"] >= 3
+        # the tracker's clock causally follows the folded worker
+        assert clock.local().peek()["ms"] >= 2500
+        # tracker-side emissions land in the same log via the ring fold
+        tr._fleet_emit("tracker.resume", "re-adopted")
+        kinds = {e["kind"] for e in tr._events_doc()["events"]}
+        assert "tracker.resume" in kinds
+    finally:
+        tr.stop()

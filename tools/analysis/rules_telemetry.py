@@ -1,6 +1,7 @@
-"""Telemetry-contract rules (T001–T004): span presence on collective
+"""Telemetry-contract rules (T001–T005): span presence on collective
 entry points, counter presence on escalation paths, /metrics family
-registration, and soak-scenario -> chaos-kind registration."""
+registration, soak-scenario -> chaos-kind registration, and
+fleet-event kind registration."""
 
 from __future__ import annotations
 
@@ -55,6 +56,7 @@ T003_SCAN = (
     os.path.join("rabit_tpu", "engine", "native.py"),
     os.path.join("rabit_tpu", "telemetry", "skew.py"),
     os.path.join("rabit_tpu", "telemetry", "slo.py"),
+    os.path.join("rabit_tpu", "telemetry", "incident.py"),
 )
 
 _T003_TYPES = {"counter", "gauge", "histogram"}
@@ -260,6 +262,88 @@ def check_soak_scenarios(ctx):
                         f"scenario '{name}' target "
                         f"{fields.get('target')!r} not in TARGETS"))
     return out
+
+
+# T005: fleet-event kind registration. The events.py module whose
+# EVENT_KINDS tuple is THE registry (emit() call sites everywhere else
+# must use kinds from it); its own rel path is exempt from the scan —
+# the registry cannot be unregistered against itself.
+_T005_EVENTS_REL = os.path.join("rabit_tpu", "telemetry", "events.py")
+
+_T005_EMIT_NAMES = {"emit", "_fleet_emit"}
+
+
+def _t005_registry():
+    """EVENT_KINDS entries parsed from events.py's AST (never imported
+    — the T003 registry discipline)."""
+    path = os.path.join(REPO, _T005_EVENTS_REL)
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(isinstance(t, ast.Name) and t.id == "EVENT_KINDS"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return None
+
+
+def _t005_emitted_kinds(tree):
+    """(kind, lineno) for every literal fleet-event emission in this
+    module: an ``events.emit("...")`` / ``self._fleet_emit("...")``
+    call whose first argument is a string constant, plus
+    ``emit_chaos("...")`` literals mapped through the ``chaos.<kind>``
+    namespace. Dynamic kinds (f-strings, variables) are emit()'s
+    runtime check's problem, not the linter's."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        f = node.func
+        fname = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        arg0 = node.args[0]
+        if not (isinstance(arg0, ast.Constant)
+                and isinstance(arg0.value, str)):
+            continue
+        if fname in _T005_EMIT_NAMES:
+            out.append((arg0.value, node.lineno))
+        elif fname == "emit_chaos":
+            out.append((f"chaos.{arg0.value}", node.lineno))
+    return out
+
+
+@rule("T005", explain="""\
+Fleet-event kind registration: every event kind emitted through the
+fleet event bus (an events.emit("...") / _fleet_emit("...") /
+emit_chaos("...") call with a literal kind) must appear in the
+EVENT_KINDS registry in rabit_tpu/telemetry/events.py — the incident
+engine's cause-priority table and the /events consumers key off kind
+names, so an unregistered kind would either crash emit() at runtime or
+(worse) ship a kind no correlation rule knows. Mirrors T003's
+metric-family discipline.""")
+def check_event_kinds(ctx):
+    if ctx.tree is None or ctx.rel == _T005_EVENTS_REL:
+        return []
+    emitted = _t005_emitted_kinds(ctx.tree)
+    if not emitted:
+        return []
+    registry = _t005_registry()
+    if registry is None:
+        return [(ctx.rel, 1, "T005",
+                 "cannot parse EVENT_KINDS from "
+                 "rabit_tpu/telemetry/events.py")]
+    return [(ctx.rel, line, "T005",
+             f"fleet-event kind '{kind}' not registered in "
+             "EVENT_KINDS (rabit_tpu/telemetry/events.py)")
+            for kind, line in emitted if kind not in registry]
 
 
 @rule("T003", explain="""\

@@ -246,6 +246,26 @@ def live_status(target):
                            "burn": v.get("burn")}
                 for v in slodoc["slos"]
                 if isinstance(v, dict) and v.get("slo")}}
+    # /incidents is the causal incident plane's route (ISSUE 20,
+    # trackers with rabit_events set); everything else lacks it and
+    # the field stays absent
+    try:
+        with urllib.request.urlopen(base + "/incidents", timeout=5.0) as r:
+            incdoc = json.load(r)
+    except (OSError, ValueError, urllib.error.URLError):
+        incdoc = None
+    if isinstance(incdoc, dict) and "open" in incdoc:
+        row = {"open": incdoc.get("open_count", 0),
+               "worst": incdoc.get("worst", "none")}
+        newest = None
+        for inc in incdoc.get("recent", []):
+            if isinstance(inc, dict) and inc.get("summary"):
+                newest = inc
+        if newest is not None:
+            row["newest"] = (f"{newest.get('id')} "
+                             f"[{newest.get('severity')}] "
+                             f"{newest['summary']}")
+        doc["incidents"] = row
     doc["ok"] = bool(health.get("ok")) and doc["exposition_ok"]
     return doc, doc["ok"]
 

@@ -54,7 +54,9 @@ sys.path.insert(0, REPO)
 from rabit_tpu import telemetry  # noqa: E402
 from rabit_tpu.chaos.proxy import ChaosProxy  # noqa: E402
 from rabit_tpu.chaos.schedule import Schedule  # noqa: E402
-from rabit_tpu.telemetry import history, slo  # noqa: E402
+from rabit_tpu.telemetry import clock as clock_mod  # noqa: E402
+from rabit_tpu.telemetry import events as events_mod  # noqa: E402
+from rabit_tpu.telemetry import history, incident, slo  # noqa: E402
 from rabit_tpu.telemetry.schema import make_header, matches  # noqa: E402
 from rabit_tpu.tracker import jobs as jobs_mod  # noqa: E402
 from rabit_tpu.tracker.standby import StandbyTracker  # noqa: E402
@@ -357,7 +359,8 @@ def run_soak(duration_s: float, qps: float, workers: int, seed: int,
                  jobs_mod.ADMISSION_QUEUE_ENV,
                  jobs_mod.MAX_FLEET_RANKS_ENV,
                  "RABIT_TRACKER_RESUME_GRACE_MS",
-                 "RABIT_JOB_FORMING_TIMEOUT_MS")}
+                 "RABIT_JOB_FORMING_TIMEOUT_MS",
+                 "RABIT_EVENTS")}
     # fleet sizing: the rolling job mix needs ~2.4 slots at the default
     # 2 submits/s, so 4 slots gives steady-state headroom while the
     # storm and the chaos windows still drive the queue into shedding
@@ -378,6 +381,13 @@ def run_soak(duration_s: float, qps: float, workers: int, seed: int,
     # inside the submitter's retry horizon so they cannot jam the fleet
     os.environ["RABIT_JOB_FORMING_TIMEOUT_MS"] = "3000"
     telemetry.reset(capacity=4096, enabled=True)
+    # causal incident plane (ISSUE 20): a soak is exactly the run that
+    # needs attribution — chaos injections, watchdog rungs, and
+    # admission churn all land on the fleet event bus, and every SLO
+    # burn below is correlated against it
+    os.environ["RABIT_EVENTS"] = "1"
+    events_mod.reset(capacity=2048, enabled=True)
+    clock_mod.reset("soak", enabled=True)
 
     spec = chaos if chaos is not None else chaos_spec(duration_s, seed)
     sched = Schedule.from_spec(spec)
@@ -480,6 +490,28 @@ def run_soak(duration_s: float, qps: float, workers: int, seed: int,
         no_data = [v["slo"] for v in verdict_rows
                    if v["state"] == slo.NO_DATA]
 
+        # root-cause attribution (ISSUE 20): every warn/violating
+        # verdict becomes an incident/v1 correlated against the fleet
+        # event log of the whole run (the soak judges at the end, so
+        # the causal window spans the duration); the verdict row
+        # carries the attribution one-liner — or an explicit
+        # ``unattributed`` marker, which --strict-attribution turns
+        # into a failed gate
+        ev_snap = events_mod.snapshot()
+        fleet_events = ev_snap["records"]
+        incidents = []
+        for v in verdict_rows:
+            if v["state"] not in (slo.WARN, slo.VIOLATING):
+                continue
+            inc = incident.correlate(
+                incident.slo_trigger(v), fleet_events,
+                window=duration_s * 1e3,
+                incident_id=f"soak-{v['slo']}")
+            incidents.append(inc)
+            v["incident"] = inc["id"]
+            v["unattributed"] = inc["unattributed"]
+            v["attribution"] = inc["summary"]
+
         def by_kind(events):
             out = {}
             for _, kind, _ in events:
@@ -517,8 +549,18 @@ def run_soak(duration_s: float, qps: float, workers: int, seed: int,
                         "link_events": by_kind(link.proxy.events),
                         "storms": len(ctl.storm_results)}
         doc["slos"] = verdict_rows
+        doc["incidents"] = incidents
+        ev_by_kind = {}
+        for rec in fleet_events:
+            k = rec.get("kind", "?")
+            ev_by_kind[k] = ev_by_kind.get(k, 0) + 1
+        doc["events"] = {"by_kind": ev_by_kind,
+                         "seq": ev_snap["seq"],
+                         "dropped": ev_snap["dropped"]}
         doc["gate"] = {"pass": not violating, "violating": violating,
-                       "no_data": no_data}
+                       "no_data": no_data,
+                       "unattributed": [i["id"] for i in incidents
+                                        if i["unattributed"]]}
         for v in verdict_rows:
             log(f"SLO {v['slo']}: value="
                 f"{'-' if v['value'] is None else format(v['value'], 'g')}"
@@ -549,6 +591,9 @@ def run_soak(duration_s: float, qps: float, workers: int, seed: int,
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+        # the event bus and clock re-read the restored env
+        events_mod.reset()
+        clock_mod.reset()
 
 
 def _parse_objectives(pairs) -> dict:
@@ -584,6 +629,10 @@ def main(argv=None) -> int:
     ap.add_argument("--history", default=history.history_path(REPO),
                     help="history JSONL to trend into (non-smoke)")
     ap.add_argument("--no-history", action="store_true")
+    ap.add_argument("--strict-attribution", action="store_true",
+                    help="fail the gate when any warn/violating SLO "
+                         "verdict's incident is unattributed (no "
+                         "candidate cause in the event window)")
     ap.add_argument("--quiet", action="store_true")
     ap.add_argument("--smoke", action="store_true",
                     help="~60 s mini-soak (CI tier 0n): low QPS, "
@@ -631,6 +680,16 @@ def main(argv=None) -> int:
         assert doc["failover"]["promoted"], doc["failover"]
         assert doc["chaos"]["tracker_events"].get("tracker_kill"), \
             doc["chaos"]
+        # attribution contract (ISSUE 20): every warn/violating
+        # verdict carries an incident/v1 with an attribution chain or
+        # the explicit unattributed marker — never silence
+        for v in doc["slos"]:
+            if v["state"] in (slo.WARN, slo.VIOLATING):
+                assert "attribution" in v and "unattributed" in v, v
+        for inc in doc["incidents"]:
+            assert matches(inc, incident.INCIDENT_KIND), inc
+            assert inc["unattributed"] or inc.get("root_cause"), inc
+        assert doc["events"]["seq"] > 0, doc["events"]
         print("soak smoke ok", file=sys.stderr)
 
     if args.out:
@@ -644,6 +703,10 @@ def main(argv=None) -> int:
                 doc, source=os.path.basename(args.out or "soak")))
         print(f"[soak] trended {added} records into {args.history}",
               file=sys.stderr)
+    if args.strict_attribution and doc["gate"]["unattributed"]:
+        print(f"[soak] strict attribution: unattributed incidents "
+              f"{doc['gate']['unattributed']}", file=sys.stderr)
+        return 1
     return 0 if doc["gate"]["pass"] else 1
 
 

@@ -210,10 +210,12 @@ def render_skew(docs):
     """Cross-rank section from >=2 round-carrying artifacts: per-round
     arrival skew/critical path plus per-rank straggler attribution.
     Returns None when fewer than two ranks contributed rounds."""
+    docs = list(docs)
     rounds = crossrank.stitch_documents(docs)
     comparable = [r for r in rounds if r["skew_s"] is not None]
     if not comparable:
         return None
+    warn = crossrank.anchor_warning(docs, rounds)
     # hierarchical allreduces stitch as one row PER PHASE (the three
     # hier.* spans share a round id): the phase column turns "round 7
     # straggled" into "round 7 straggled in the inter-host phase".
@@ -241,6 +243,8 @@ def render_skew(docs):
     out += (f"\n\nStraggler: rank {worst['rank']} caused "
             f"{_fmt_s(worst['skew_caused_s'])} of arrival skew across "
             f"{worst['straggler_rounds']} round(s)")
+    if warn is not None:
+        out += "\n\n**WARNING**: " + warn["message"]
     return out
 
 
@@ -349,8 +353,72 @@ def render_tracker_bench(doc):
     return out
 
 
+def render_fleet_events(doc, last_n=32):
+    """fleet_event/v1: either one HLC-stamped record or a fleet event
+    log (the tracker's /events document) — rendered as an ordered
+    event table."""
+    events = doc.get("events")
+    if events is None:
+        events = [doc]  # a single shipped record
+    events = events[-last_n:]
+    rows = []
+    for e in events:
+        hlc = e.get("hlc") or {}
+        stamp = (f"{hlc.get('ms', '?')}+{hlc.get('lc', 0)}"
+                 if hlc else f"{e.get('t_unix', 0.0):.3f}")
+        rows.append((stamp, e.get("kind", "?"),
+                     e.get("source", e.get("job", "")) or "-",
+                     "-" if e.get("rank") is None else e["rank"],
+                     e.get("detail", "") or "-"))
+    title = (f"Fleet events — {len(events)} record(s) shown, "
+             f"{doc.get('dropped', 0)} dropped "
+             f"({doc.get('timestamp_utc', '')})")
+    return title + "\n\n" + _md_table(
+        ("hlc/t", "kind", "source", "rank", "detail"), rows)
+
+
+def render_incident(doc):
+    """incident/v1: the attribution chain behind one SLO burn or
+    abort — root cause first, severity, affected jobs/ranks."""
+    sev = doc.get("severity", "?")
+    title = (f"Incident `{doc.get('id', '?')}` — "
+             f"{'**CRITICAL**' if sev == 'critical' else sev}: "
+             f"{doc.get('summary', '')} "
+             f"({doc.get('timestamp_utc', '')})")
+    parts = [title]
+    if doc.get("unattributed"):
+        parts.append("No candidate cause inside the "
+                     f"{doc.get('window_ms', '?')} ms causal window "
+                     "(explicitly unattributed).")
+    else:
+        rows = []
+        root_seq = (doc.get("root_cause") or {}).get("seq")
+        for e in doc.get("attribution", []):
+            hlc = e.get("hlc") or {}
+            stamp = (f"{hlc.get('ms', '?')}+{hlc.get('lc', 0)}"
+                     if hlc else f"{e.get('t_unix', 0.0):.3f}")
+            mark = ("**root**" if root_seq is not None
+                    and e.get("seq") == root_seq else "")
+            rows.append((stamp, e.get("kind", "?"),
+                         "-" if e.get("rank") is None else e["rank"],
+                         e.get("detail", "") or "-", mark))
+        parts.append(f"Attribution chain ({len(rows)} event(s), "
+                     f"window {doc.get('window_ms', '?')} ms)\n\n" +
+                     _md_table(("hlc/t", "kind", "rank", "detail", ""),
+                               rows))
+    scope = []
+    if doc.get("jobs"):
+        scope.append("jobs: " + ", ".join(doc["jobs"]))
+    if doc.get("ranks"):
+        scope.append("ranks: " + ", ".join(str(r) for r in doc["ranks"]))
+    if scope:
+        parts.append("Affected " + "; ".join(scope))
+    return "\n\n".join(parts)
+
+
 _KINDS = ("telemetry_summary", "telemetry_fleet", "telemetry_trace",
-          "flight_record", "bench_sentinel", "soak", "tracker_bench")
+          "flight_record", "bench_sentinel", "soak", "tracker_bench",
+          "fleet_event", "incident")
 
 
 def recognized(doc):
@@ -375,6 +443,10 @@ def render(doc):
         return render_soak(doc)
     if matches(doc, "tracker_bench"):
         return render_tracker_bench(doc)
+    if matches(doc, "fleet_event"):
+        return render_fleet_events(doc)
+    if matches(doc, "incident"):
+        return render_incident(doc)
     if doc.get("schema") in ("rabit_tpu.collective_sweep/v1",
                              "rabit_tpu.collective_sweep/v2"):
         return render_sweep(doc)
